@@ -1,0 +1,23 @@
+"""DeepInteract-TPU: a TPU-native (JAX/XLA/Pallas) framework for protein
+interface contact prediction with the capabilities of DeepInteract
+(Geometric Transformers for Protein Interface Contact Prediction, ICLR'22).
+
+This is a ground-up TPU-first redesign, not a port:
+
+* Residue graphs are statically-shaped, fixed-degree (kNN) dense tensors
+  laid out as ``[N, K]`` neighbor slots instead of dynamic sparse graphs,
+  so every graph op maps onto dense MXU-friendly einsums and masked
+  softmaxes (no scatter/gather message passing UDFs).
+* Parallelism is expressed with ``jax.sharding.Mesh`` + ``shard_map``
+  (data-parallel axis over complexes, context-parallel axis over the
+  L1 x L2 pair map) with XLA collectives over ICI — replacing the
+  reference's Lightning DDP / NCCL stack.
+* The edge-softmax/aggregation hot loop has a fused Pallas TPU kernel.
+
+Reference layout citations in docstrings point into the upstream repo
+(``/root/reference``) for parity checking.
+"""
+
+__version__ = "0.1.0"
+
+from deepinteract_tpu import constants  # noqa: F401

@@ -1,0 +1,3 @@
+"""Data substrate: statically-shaped residue graphs, featurization, datasets."""
+
+from deepinteract_tpu.data.graph import ProteinGraph, PairedComplex, pad_graph, stack_graphs  # noqa: F401

@@ -1,0 +1,156 @@
+"""Golden-value and invariance tests for the geometric featurizer."""
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu import constants
+from deepinteract_tpu.data import features as F
+from deepinteract_tpu.data.graph import pad_graph, pick_bucket
+from deepinteract_tpu.data.synthetic import random_backbone, random_complex, random_residue_feats
+
+
+def test_knn_edges_sorted_and_self_first(rng):
+    coords = rng.normal(size=(50, 3)).astype(np.float32)
+    nbr, sq = F.knn_edges(coords, 10, self_loops=True)
+    assert nbr.shape == (50, 10) and sq.shape == (50, 10)
+    np.testing.assert_array_equal(nbr[:, 0], np.arange(50))  # self first
+    assert np.all(np.diff(sq, axis=1) >= 0)  # ascending distances
+
+    nbr2, sq2 = F.knn_edges(coords, 10, self_loops=False)
+    assert not np.any(nbr2 == np.arange(50)[:, None])
+    assert np.all(sq2[:, 0] > 0)
+
+
+def test_dihedrals_match_direct_formula(rng):
+    backbone = random_backbone(30, rng)
+    feats = F.dihedral_features(backbone)
+    assert feats.shape == (30, 6)
+    # cos^2 + sin^2 == 1 for interior residues; padded entries give cos(0)=1.
+    sq = feats[:, :3] ** 2 + feats[:, 3:] ** 2
+    np.testing.assert_allclose(sq, np.ones_like(sq), atol=1e-5)
+    # Reference padding scheme: phi[0], psi[-1], omega[-1] are zeroed.
+    assert feats[0, 0] == 1.0 and feats[0, 3] == 0.0
+
+    # Golden check of one interior dihedral against the textbook formula.
+    x = backbone[:, :3, :].reshape(-1, 3)
+
+    def dihedral(p0, p1, p2, p3):
+        b0, b1, b2 = p1 - p0, p2 - p1, p3 - p2
+        b1 = b1 / np.linalg.norm(b1)
+        v = b0 - np.dot(b0, b1) * b1
+        w = b2 - np.dot(b2, b1) * b1
+        return np.arctan2(np.dot(np.cross(b1, v), w), np.dot(v, w))
+
+    # Padded slot s holds points x[s-1..s+2]; the reference convention
+    # (angle between successive bond-plane normals) is the supplement of the
+    # textbook dihedral: |D_ref| = pi - |D_std|.
+    for s in (4, 8, 13):
+        expected = np.pi - abs(dihedral(x[s - 1], x[s], x[s + 1], x[s + 2]))
+        got = np.arctan2(feats[s // 3, 3 + s % 3], feats[s // 3, s % 3])
+        assert abs(abs(got) - expected) < 1e-4
+
+
+def test_rbf_peaks_at_bin_centers():
+    mu = np.linspace(0, 20, constants.NUM_RBF)
+    rbf = F.rbf_features(mu)
+    np.testing.assert_allclose(np.diag(rbf), 1.0, atol=1e-6)
+    assert rbf.shape == (constants.NUM_RBF, constants.NUM_RBF)
+
+
+def test_quaternions_unit_norm_and_identity(rng):
+    r = np.broadcast_to(np.eye(3), (4, 5, 3, 3))
+    q = F.rotations_to_quaternions(r)
+    np.testing.assert_allclose(q[..., 3], 1.0, atol=1e-6)  # identity -> w=1
+    np.testing.assert_allclose(np.linalg.norm(q, axis=-1), 1.0, atol=1e-5)
+    # Zero matrix (padded frames) -> (0,0,0,1), no NaNs.
+    q0 = F.rotations_to_quaternions(np.zeros((2, 3, 3)))
+    np.testing.assert_allclose(q0, np.array([[0, 0, 0, 1.0]] * 2), atol=1e-6)
+
+
+def test_orientation_features_rotation_invariance(rng):
+    """dU and Q live in local frames => invariant to global rotation."""
+    ca = random_backbone(40, rng)[:, 1, :]
+    nbr, _ = F.knn_edges(ca, 8)
+    du1, q1 = F.orientation_features(ca, nbr)
+
+    theta = 0.7
+    rot = np.array(
+        [[np.cos(theta), -np.sin(theta), 0], [np.sin(theta), np.cos(theta), 0], [0, 0, 1]],
+        dtype=np.float64,
+    )
+    ca_rot = (ca @ rot.T).astype(np.float32)
+    # Use identical neighbor sets (float32 rounding can flip argsort ties).
+    du2, q2 = F.orientation_features(ca_rot, nbr)
+    np.testing.assert_allclose(du1, du2, atol=1e-3)
+    # The reference's R = O_i^T O_j transforms as G R G^T under global
+    # rotation G: quaternion w and the xyz norm are invariant, while the
+    # axis rotates with G (matches Ingraham struct2seq semantics).
+    np.testing.assert_allclose(q1[..., 3], q2[..., 3], atol=1e-3)
+    np.testing.assert_allclose(
+        np.linalg.norm(q1[..., :3], axis=-1), np.linalg.norm(q2[..., :3], axis=-1), atol=1e-3
+    )
+    np.testing.assert_allclose(q1[..., :3] @ rot.T, q2[..., :3], atol=1e-3)
+
+
+def test_featurize_chain_schema(rng):
+    n = 70
+    backbone = random_backbone(n, rng)
+    raw = F.featurize_chain(backbone, random_residue_feats(n, rng), knn=20, rng=rng)
+    assert raw["node_feats"].shape == (n, constants.NUM_NODE_FEATS)
+    assert raw["edge_feats"].shape == (n, 20, constants.NUM_EDGE_FEATS)
+    assert raw["nbr_idx"].shape == (n, 20)
+    assert raw["src_nbr_eids"].shape == (n, 20, constants.GEO_NBRHD_SIZE)
+    for key, arr in raw.items():
+        assert np.all(np.isfinite(arr)), f"non-finite values in {key}"
+    # Min-max normalized columns stay in [0, 1].
+    assert 0 <= raw["node_feats"][:, constants.NODE_POS_ENC].min()
+    assert raw["node_feats"][:, constants.NODE_POS_ENC].max() == 1.0
+    w = raw["edge_feats"][..., constants.EDGE_WEIGHT]
+    assert w.min() == 0.0 and w.max() == 1.0
+    # Edge (i, k): src = center i, dst = nbr_idx[i, k]. Neighborhood edge ids
+    # are sampled from the owning row of each endpoint.
+    i, k = 5, 3
+    j = raw["nbr_idx"][i, k]
+    assert np.all(raw["src_nbr_eids"][i, k] // 20 == i)
+    assert np.all(raw["dst_nbr_eids"][i, k] // 20 == j)
+    # pos enc is sin(src - dst)
+    np.testing.assert_allclose(
+        raw["edge_feats"][i, k, constants.EDGE_POS_ENC], np.sin(float(i) - float(j)), atol=1e-6
+    )
+
+
+def test_pad_graph_and_bucketing(rng):
+    n = 70
+    backbone = random_backbone(n, rng)
+    raw = F.featurize_chain(backbone, random_residue_feats(n, rng), rng=rng)
+    assert pick_bucket(70) == 128
+    assert pick_bucket(257) == 512  # long-context tier: multiples of top bucket
+    g = pad_graph(raw, 128)
+    assert g.node_feats.shape == (128, constants.NUM_NODE_FEATS)
+    assert int(g.num_nodes) == n
+    assert g.node_mask.sum() == n
+    # Padded nodes self-point so downstream gathers stay in bounds.
+    assert np.all(g.nbr_idx[n:] == np.arange(n, 128)[:, None])
+    assert np.all(g.nbr_idx < 128)
+    assert np.all(g.src_nbr_eids < 128 * 20)
+
+
+def test_random_complex_labels(rng):
+    cx = random_complex(60, 50, rng=rng)
+    assert cx.contact_map.shape == (cx.graph1.n_padded, cx.graph2.n_padded)
+    assert cx.contact_map.sum() > 0, "synthetic complex should have an interface"
+    # Examples agree with the dense map.
+    real = cx.examples[cx.example_mask]
+    assert np.all(cx.contact_map[real[:, 0], real[:, 1]] == real[:, 2])
+    # No labels outside the valid region.
+    assert cx.contact_map[60:, :].sum() == 0 and cx.contact_map[:, 50:].sum() == 0
+
+
+def test_featurizer_deterministic_given_rng(rng):
+    n = 40
+    backbone = random_backbone(n, rng)
+    feats = random_residue_feats(n, rng)
+    r1 = F.featurize_chain(backbone, feats, rng=np.random.default_rng(7))
+    r2 = F.featurize_chain(backbone, feats, rng=np.random.default_rng(7))
+    for key in r1:
+        np.testing.assert_array_equal(r1[key], r2[key])
