@@ -176,10 +176,11 @@ def amide_normal_vectors(backbone: np.ndarray, cb: Optional[np.ndarray] = None) 
 
 
 def amide_angle_features(norm_vecs: np.ndarray, nbr_idx: np.ndarray) -> np.ndarray:
-    """Min-max-normalized angle between dst and src amide normals per edge
-    [N, K] (reference: deepinteract_utils.py:513-530, NaN -> 0)."""
-    v_dst = np.broadcast_to(norm_vecs[:, None, :], (*nbr_idx.shape, 3))
-    v_src = norm_vecs[nbr_idx]
+    """Min-max-normalized angle between src and dst amide normals per edge
+    [N, K] (reference: deepinteract_utils.py:513-530, NaN -> 0). The angle is
+    symmetric in the two endpoints."""
+    v_src = np.broadcast_to(norm_vecs[:, None, :], (*nbr_idx.shape, 3))  # center i
+    v_dst = norm_vecs[nbr_idx]  # neighbor
     denom = np.linalg.norm(v_dst, axis=-1) * np.linalg.norm(v_src, axis=-1)
     with np.errstate(invalid="ignore", divide="ignore"):
         cos = np.sum(v_dst * v_src, axis=-1) / denom

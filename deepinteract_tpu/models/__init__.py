@@ -1,0 +1,3 @@
+"""Model layer: Geometric Transformer, interaction decoders, full network."""
+
+from deepinteract_tpu.models.geometric_transformer import GeometricTransformer, GTConfig  # noqa: F401

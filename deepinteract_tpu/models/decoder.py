@@ -1,0 +1,263 @@
+"""Dilated squeeze-excitation ResNet interaction decoder (NHWC, XLA convs).
+
+Reimplements the reference decoder stack
+(``project/utils/deepinteract_modules.py:954-1248``):
+  * SEBlock                     (:954-970)
+  * ResNet (dilated bottleneck) (:973-1106)
+  * MultiHeadRegionalAttention  (:1109-1152)
+  * ResNet2DInputWithOptAttention (:1155-1248)
+
+TPU-first changes: NHWC layout (TPU conv native), instance norm implemented
+with pair-map masking so padded rows/cols do not pollute statistics, and the
+whole stack is shape-static so XLA fuses the 1x1 convs into the dilated 3x3s.
+The final positive-class bias is initialized to -7 so positives start at
+p ~= 0.001 (reference :1224-1226).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    """Defaults mirror the reference (deepinteract_modules.py:1157-1167,
+    LitGINI num_interact_layers=14 -> num_chunks=14)."""
+
+    num_chunks: int = 14
+    in_channels: int = 256  # 2 * GNN hidden
+    num_channels: int = 128
+    num_classes: int = 2
+    dilation_cycle: Sequence[int] = (1, 2, 4, 8)
+    use_attention: bool = False
+    num_attention_heads: int = 4
+    dropout_rate: float = 0.2
+    region_size: int = 3
+
+
+def masked_instance_norm(x: jnp.ndarray, mask: Optional[jnp.ndarray], scale, bias, eps=1e-6):
+    """InstanceNorm2d over valid H, W positions per sample/channel.
+
+    x: [B, H, W, C]; mask: [B, H, W] or None. Reference uses
+    ``nn.InstanceNorm2d(eps=1e-06, affine=True)`` on unpadded maps; masking
+    makes the padded formulation equivalent.
+    """
+    if mask is None:
+        mean = jnp.mean(x, axis=(1, 2), keepdims=True)
+        var = jnp.var(x, axis=(1, 2), keepdims=True)
+    else:
+        m = mask[..., None].astype(x.dtype)
+        count = jnp.maximum(jnp.sum(m, axis=(1, 2), keepdims=True), 1.0)
+        mean = jnp.sum(x * m, axis=(1, 2), keepdims=True) / count
+        var = jnp.sum(m * (x - mean) ** 2, axis=(1, 2), keepdims=True) / count
+    y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps)) * scale + bias
+    if mask is not None:
+        y = y * mask[..., None]
+    return y
+
+
+class InstanceNorm(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        scale = self.param("scale", nn.initializers.ones, (self.features,))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return masked_instance_norm(x, mask, scale, bias)
+
+
+class SEBlock(nn.Module):
+    """Squeeze-and-excitation over the (masked) spatial mean
+    (deepinteract_modules.py:954-970)."""
+
+    channels: int
+    ratio: int = 16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        if mask is None:
+            pooled = jnp.mean(x, axis=(1, 2))
+        else:
+            m = mask[..., None].astype(x.dtype)
+            pooled = jnp.sum(x * m, axis=(1, 2)) / jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0)
+        h = nn.relu(nn.Dense(max(1, self.channels // self.ratio))(pooled))
+        h = nn.relu(nn.Dense(self.channels)(h))
+        gate = nn.sigmoid(h)
+        return x * gate[:, None, None, :]
+
+
+class BottleneckBlock(nn.Module):
+    """One dilated bottleneck unit: [inorm] - act - 1x1 down - [inorm] - act -
+    3x3 dilated - [inorm] - act - 1x1 up - SE - residual
+    (reference ResNet inner loop, deepinteract_modules.py:1060-1086)."""
+
+    channels: int
+    dilation: int
+    use_inorm: bool
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        half = self.channels // 2
+        residual = x
+        if self.use_inorm:
+            x = InstanceNorm(self.channels, name="inorm_1")(x, mask)
+        x = nn.elu(x)
+        x = nn.Conv(half, (1, 1), name="conv2d_1")(x)
+        if self.use_inorm:
+            x = InstanceNorm(half, name="inorm_2")(x, mask)
+        x = nn.elu(x)
+        if mask is not None:
+            # Zero the padded region before the only spatially-mixing conv:
+            # conv biases make padded pixels nonzero mid-block, and a dilated
+            # 3x3 would smear them into real pixels near the pad boundary.
+            # With this mask, padded buckets match the reference's unpadded
+            # zero-boundary conv behavior exactly.
+            x = x * mask[..., None]
+        x = nn.Conv(
+            half, (3, 3), kernel_dilation=(self.dilation, self.dilation),
+            padding=self.dilation, name="conv2d_2",
+        )(x)
+        if self.use_inorm:
+            x = InstanceNorm(half, name="inorm_3")(x, mask)
+        x = nn.elu(x)
+        x = nn.Conv(self.channels, (1, 1), name="conv2d_3")(x)
+        x = SEBlock(self.channels, name="se_block")(x, mask)
+        out = x + residual
+        if mask is not None:
+            out = out * mask[..., None]
+        return out
+
+
+class DilatedResNet(nn.Module):
+    """num_chunks x dilation_cycle bottleneck blocks (+2 optional extra
+    blocks) with optional initial 1x1 projection
+    (reference ResNet, deepinteract_modules.py:973-1106)."""
+
+    channels: int
+    num_chunks: int
+    dilation_cycle: Sequence[int] = (1, 2, 4, 8)
+    use_inorm: bool = False
+    initial_projection: bool = False
+    extra_blocks: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        if self.initial_projection:
+            x = nn.Conv(self.channels, (1, 1), name="init_proj")(x)
+        for i in range(self.num_chunks):
+            for d in self.dilation_cycle:
+                x = BottleneckBlock(
+                    self.channels, d, self.use_inorm, name=f"block_{i}_{d}"
+                )(x, mask)
+        if self.extra_blocks:
+            for i in range(2):
+                x = BottleneckBlock(
+                    self.channels, 1, self.use_inorm, name=f"extra_block_{i}"
+                )(x, mask)
+        return x
+
+
+class RegionalAttention(nn.Module):
+    """Multi-head attention over a local region_size x region_size window
+    (reference MultiHeadRegionalAttention, deepinteract_modules.py:1109-1152).
+
+    TPU-first formulation: instead of the reference's Conv3d "stretch"
+    weight trick, window extraction is ``jax.lax`` patch gathering via
+    shifted pads — the math (softmax over the s^2 window per pixel) is
+    identical.
+    """
+
+    channels: int
+    d_k: int = 16
+    num_heads: int = 4
+    region_size: int = 3
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        b, hh, ww, _ = x.shape
+        s = self.region_size
+        if mask is not None:
+            # Zeroing the padded region makes window slots that fall in the
+            # pad behave exactly like the reference's zero-padded image
+            # boundary (q/k/v are bias-free 1x1 convs, so qk = 0 there).
+            x = x * mask[..., None]
+        q = nn.Conv(self.d_k, (1, 1), use_bias=False, name="q_layer")(x)
+        k = nn.Conv(self.d_k, (1, 1), use_bias=False, name="k_layer")(x)
+        v = nn.Conv(self.channels, (1, 1), use_bias=False, name="v_layer")(x)
+
+        def patches(t):  # [B,H,W,C] -> [B,H,W,s*s,C]
+            pad = s // 2
+            tp = jnp.pad(t, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+            shifts = [
+                tp[:, dy : dy + hh, dx : dx + ww, :]
+                for dy in range(s)
+                for dx in range(s)
+            ]
+            return jnp.stack(shifts, axis=3)
+
+        qk = patches(q) * patches(k)  # [B,H,W,s2,d_k]
+        n_head = self.num_heads
+        dk_per_head = self.d_k // n_head
+        qk = qk.reshape(b, hh, ww, s * s, n_head, dk_per_head).sum(-1)  # [B,H,W,s2,n_head]
+        att = nn.softmax(qk / jnp.sqrt(jnp.asarray(self.d_k, x.dtype)), axis=3)
+        att = nn.Dropout(self.dropout_rate, deterministic=not train)(att)
+        v_p = patches(v).reshape(b, hh, ww, s * s, n_head, self.channels // n_head)
+        out = jnp.einsum("bhwsn,bhwsnc->bhwnc", att, v_p).reshape(b, hh, ww, self.channels)
+        if mask is not None:
+            out = out * mask[..., None]
+        return out
+
+
+class InteractionDecoder(nn.Module):
+    """Full decoder head: 1x1 conv + inorm -> base dilated ResNet (inorm) ->
+    phase-2 ResNet (+extra blocks) -> 1x1 conv to classes
+    (ResNet2DInputWithOptAttention, deepinteract_modules.py:1155-1248)."""
+
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, pair_tensor: jnp.ndarray, mask=None, train: bool = False):
+        cfg = self.cfg
+        x = nn.Conv(cfg.num_channels, (1, 1), name="conv2d_1")(pair_tensor)
+        x = nn.elu(InstanceNorm(cfg.num_channels, name="inorm_1")(x, mask))
+
+        x = nn.elu(
+            DilatedResNet(
+                cfg.num_channels, cfg.num_chunks, cfg.dilation_cycle,
+                use_inorm=True, initial_projection=True, name="base_resnet",
+            )(x, mask)
+        )
+        if cfg.use_attention:
+            x = nn.elu(RegionalAttention(
+                cfg.num_channels, num_heads=cfg.num_attention_heads,
+                region_size=cfg.region_size, dropout_rate=cfg.dropout_rate, name="mha2d_1",
+            )(x, mask, train))
+
+        x = nn.elu(
+            DilatedResNet(
+                cfg.num_channels, 1, cfg.dilation_cycle,
+                use_inorm=False, initial_projection=True, extra_blocks=True,
+                name="phase2_resnet",
+            )(x, mask)
+        )
+        if cfg.use_attention:
+            x = nn.elu(RegionalAttention(
+                cfg.num_channels, num_heads=cfg.num_attention_heads,
+                region_size=cfg.region_size, dropout_rate=cfg.dropout_rate, name="mha2d_2",
+            )(x, mask, train))
+
+        # Positive-class bias -7 => initial positive probability ~0.001
+        # (reference reset_parameters, deepinteract_modules.py:1219-1226).
+        def final_bias(key, shape, dtype=jnp.float32):
+            bias = jnp.zeros(shape, dtype)
+            return bias.at[1].set(-7.0)
+
+        logits = nn.Conv(cfg.num_classes, (1, 1), bias_init=final_bias, name="phase2_conv")(x)
+        if mask is not None:
+            logits = logits * mask[..., None]
+        return logits

@@ -1,0 +1,169 @@
+"""Shared building blocks: init scheme, masked normalization, residual blocks.
+
+The reference normalizes node/edge features with ``nn.BatchNorm1d`` over the
+concatenation of all graphs in a batch (``deepinteract_modules.py:605-613``).
+Our graphs are padded, so batch statistics must be computed over *valid*
+elements only — hence the masked BatchNorm here. LayerNorm ('layer' mode,
+reference ``norm_to_apply``) is positionwise and needs no masking.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def glorot_orthogonal(scale: float = 2.0) -> Callable:
+    """Orthogonal init rescaled to Glorot variance (reference
+    ``glorot_orthogonal``, deepinteract_utils.py:47-52): W <- W * sqrt(scale /
+    ((fan_in + fan_out) * var(W))) applied to an (approximately) orthogonal
+    matrix produced by Newton-Schulz iteration — see the comment below for
+    why QR is avoided.
+    """
+    import math
+
+    def init(key, shape, dtype=jnp.float32):
+        if len(shape) < 2:
+            raise ValueError("glorot_orthogonal requires >=2D shapes")
+        rows = math.prod(shape[:-1])
+        cols = shape[-1]
+        # Orthogonalize via Newton-Schulz iteration (Y <- 1.5 Y - 0.5 Y Y^T Y)
+        # instead of QR: pure matmuls, so it compiles instantly on every
+        # backend (XLA builds a fresh QR kernel per parameter shape, which
+        # made init take minutes on CPU, and callbacks are unsupported on
+        # some TPU plugins). Exactness of orthogonality is immaterial here —
+        # the Glorot variance rescale below dominates the statistics.
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        y = a / jnp.linalg.norm(a)  # all singular values <= 1
+
+        def ns_step(y, _):
+            return 1.5 * y - 0.5 * y @ (y.T @ y), None
+
+        y, _ = jax.lax.scan(ns_step, y, None, length=48)
+        if rows < cols:
+            y = y.T
+        w = y.reshape(shape)
+        var = jnp.maximum(jnp.var(w), 1e-12)
+        return (w * jnp.sqrt(scale / ((rows + cols) * var))).astype(dtype)
+
+    return init
+
+
+def uniform_sqrt3() -> Callable:
+    """U(-sqrt(3), sqrt(3)) — reference node-index embedding init
+    (deepinteract_modules.py:183)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        s = jnp.sqrt(3.0)
+        return jax.random.uniform(key, shape, dtype, minval=-s, maxval=s)
+
+    return init
+
+
+class GODense(nn.Module):
+    """Dense layer with glorot_orthogonal kernel init and zero bias."""
+
+    features: int
+    use_bias: bool = True
+    scale: float = 2.0
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.features,
+            use_bias=self.use_bias,
+            kernel_init=glorot_orthogonal(self.scale),
+            bias_init=nn.initializers.zeros,
+        )(x)
+
+
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm over valid elements of arbitrarily many leading axes.
+
+    Equivalent to torch ``BatchNorm1d`` applied to the flattened list of real
+    nodes/edges in a batch (the reference's usage), with running statistics in
+    the ``batch_stats`` collection. ``mask`` broadcasts against all but the
+    channel axis.
+    """
+
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.1  # torch convention: new = (1-m)*old + m*batch
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, mask, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        ch = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean", lambda: jnp.zeros(ch))
+        ra_var = self.variable("batch_stats", "var", lambda: jnp.ones(ch))
+        scale = self.param("scale", nn.initializers.ones, (ch,))
+        bias = self.param("bias", nn.initializers.zeros, (ch,))
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            m = jnp.broadcast_to(mask[..., None], x.shape).astype(x.dtype)
+            count = jnp.maximum(jnp.sum(m), 1.0)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.sum(x * m, axis=axes) / count
+            var = jnp.sum(m * (x - mean) ** 2, axis=axes) / count
+            if not self.is_initializing():
+                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
+                # torch tracks the unbiased variance in running stats
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
+        return jnp.where(mask[..., None], y, x)
+
+
+class FeatureNorm(nn.Module):
+    """'batch' or 'layer' normalization switch (reference ``norm_to_apply``,
+    deepinteract_modules.py:605-613)."""
+
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool = False):
+        if self.norm_type == "layer":
+            return nn.LayerNorm()(x)
+        return MaskedBatchNorm()(x, mask, use_running_average=not train)
+
+
+class ResBlock(nn.Module):
+    """Conformation-module residual block (deepinteract_modules.py:455-497):
+    x + (Linear-Norm-SiLU) x3, with the *same* norm instance reused at all
+    three positions (a reference quirk: one ``norm_layer`` object appears
+    three times in its ModuleList, sharing parameters and running stats)."""
+
+    hidden: int
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool = False):
+        shared_norm = FeatureNorm(self.norm_type, name="shared_norm")
+        h = x
+        for i in range(3):
+            h = GODense(self.hidden, name=f"linear_{i}")(h)
+            h = shared_norm(h, mask, train=train)
+            h = nn.silu(h)
+        return x + h
+
+
+class MLP(nn.Module):
+    """Transformer FFN: Dense(2C, no bias) - SiLU - Dropout - Dense(C, no
+    bias) (reference node/edge_feats_MLP, deepinteract_modules.py:628-650)."""
+
+    hidden: int
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = GODense(self.hidden * 2, use_bias=False)(x)
+        h = nn.silu(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return GODense(self.hidden, use_bias=False)(h)

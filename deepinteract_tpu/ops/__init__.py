@@ -1,0 +1,3 @@
+"""TPU compute primitives: edge attention (jnp reference + Pallas kernel)."""
+
+from deepinteract_tpu.ops.attention import edge_attention  # noqa: F401

@@ -1,15 +1,33 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX init.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 SURVEY.md §4: the standard JAX way to exercise multi-device collectives
-without TPU hardware is ``--xla_force_host_platform_device_count``.
+without TPU hardware is ``--xla_force_host_platform_device_count``. In this
+environment a TPU PJRT plugin is registered by a sitecustomize hook *before*
+conftest runs, so setting env vars alone is not enough — we also flip the
+platform config and clear the already-initialized backend cache.
 """
 
 import os
+import re
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # re-initialize backends if a TPU plugin already claimed them
+    from jax._src import xla_bridge as _xb
+
+    if _xb._backends:
+        _xb._clear_backends()
+except Exception:  # pragma: no cover - best effort, plain envs need nothing
+    pass
+
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
+assert len(jax.devices()) == 8, "tests expect an 8-device virtual CPU mesh"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,176 @@
+"""Tests for the Geometric Transformer core."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepinteract_tpu import constants
+from deepinteract_tpu.data.graph import stack_graphs
+from deepinteract_tpu.data.synthetic import random_chain_graph
+from deepinteract_tpu.models.geometric_transformer import GeometricTransformer, GTConfig
+from deepinteract_tpu.models.layers import MaskedBatchNorm, glorot_orthogonal
+from deepinteract_tpu.ops.attention import edge_attention
+
+
+def make_batch(rng, lengths=(60, 45), n_pad=64):
+    graphs = [random_chain_graph(n, rng, n_pad=n_pad)[0] for n in lengths]
+    return stack_graphs(graphs)
+
+
+def embed_nodes(graph, hidden=128):
+    """Stand-in for the model's input embedding."""
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (constants.NUM_NODE_FEATS, hidden)) * 0.05
+    return jnp.asarray(graph.node_feats) @ w
+
+
+def init_and_apply(cfg, graph, train=False, seed=0):
+    model = GeometricTransformer(cfg)
+    node_in = embed_nodes(graph, cfg.hidden)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(seed + 1)},
+        graph, node_in, train=False,
+    )
+    out, updates = model.apply(
+        variables, graph, node_in, train=train,
+        rngs={"dropout": jax.random.PRNGKey(seed + 2)},
+        mutable=["batch_stats"] if train else [],
+    )
+    return out, variables
+
+
+def test_forward_shapes_and_finite(rng):
+    graph = make_batch(rng)
+    cfg = GTConfig(num_layers=2, dropout_rate=0.0)
+    (node_out, edge_out), variables = init_and_apply(cfg, graph)
+    assert node_out.shape == (2, 64, 128)
+    assert edge_out.shape == (2, 64, constants.KNN, 128)
+    assert np.all(np.isfinite(node_out))
+    # Padded nodes produce zeros.
+    mask = np.asarray(graph.node_mask)
+    assert np.abs(np.asarray(node_out)[~mask]).max() == 0.0
+
+
+def test_padding_invariance(rng):
+    """The same chain padded to different bucket sizes must produce identical
+    node features on the real nodes — the core static-shape correctness
+    property (layer norm mode; batch-norm stats are also mask-correct but
+    compared separately)."""
+    g64 = random_chain_graph(50, np.random.default_rng(7), n_pad=64)[0]
+    g96 = random_chain_graph(50, np.random.default_rng(7), n_pad=96)[0]
+    cfg = GTConfig(num_layers=2, dropout_rate=0.0, norm_type="layer")
+
+    model = GeometricTransformer(cfg)
+    node_in64 = embed_nodes(stack_graphs([g64]), cfg.hidden)
+    node_in96 = embed_nodes(stack_graphs([g96]), cfg.hidden)
+    variables = model.init(jax.random.PRNGKey(0), stack_graphs([g64]), node_in64, train=False)
+    out64, _ = model.apply(variables, stack_graphs([g64]), node_in64, train=False)
+    out96, _ = model.apply(variables, stack_graphs([g96]), node_in96, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out64)[0, :50], np.asarray(out96)[0, :50], atol=2e-5
+    )
+
+
+def test_masked_batchnorm_ignores_padding(rng):
+    x_small = jnp.asarray(rng.normal(size=(1, 10, 4)).astype(np.float32))
+    mask_small = jnp.ones((1, 10), dtype=bool)
+    x_big = jnp.concatenate([x_small, 99.0 * jnp.ones((1, 6, 4))], axis=1)
+    mask_big = jnp.concatenate([mask_small, jnp.zeros((1, 6), dtype=bool)], axis=1)
+
+    bn = MaskedBatchNorm()
+    v = bn.init(jax.random.PRNGKey(0), x_small, mask_small, use_running_average=False)
+    y_small, s1 = bn.apply(v, x_small, mask_small, use_running_average=False,
+                           mutable=["batch_stats"])
+    y_big, s2 = bn.apply(v, x_big, mask_big, use_running_average=False,
+                         mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y_small), np.asarray(y_big)[:, :10], atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(s1["batch_stats"]["mean"]), np.asarray(s2["batch_stats"]["mean"]), atol=1e-6
+    )
+
+
+def test_attention_modes_agree_on_symmetric_graph():
+    """On a symmetric kNN graph, gather and scatter aggregation coincide."""
+    b, n, k_deg, h, d = 1, 6, 2, 2, 4
+    # Ring graph: each node's neighbors are (i-1, i+1) — symmetric.
+    nbr = np.stack([(np.arange(n) - 1) % n, (np.arange(n) + 1) % n], axis=1)[None]
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (b, n, h, d))
+    k_ = jax.random.normal(ks[1], (b, n, h, d))
+    v = jax.random.normal(ks[2], (b, n, h, d))
+    pe = jax.random.normal(ks[3], (b, n, k_deg, h, d))
+    mask = jnp.ones((b, n, k_deg), dtype=bool)
+
+    h_g, _ = edge_attention(q, k_, v, pe, jnp.asarray(nbr), mask, mode="gather")
+    # Scatter with mirrored edge projections: edge (i -> j) in gather mode
+    # corresponds to edge (j -> i); on the ring, slot s of node i maps to
+    # slot 1-s of its neighbor.
+    pe_m = np.zeros_like(np.asarray(pe))
+    for i in range(n):
+        for s in range(k_deg):
+            j = nbr[0, i, s]
+            s_back = list(nbr[0, j]).index(i)
+            pe_m[0, j, s_back] = np.asarray(pe)[0, i, s]
+    h_s, _ = edge_attention(q, k_, v, jnp.asarray(pe_m), jnp.asarray(nbr), mask, mode="scatter")
+    np.testing.assert_allclose(np.asarray(h_g), np.asarray(h_s), atol=1e-5)
+
+
+def test_scatter_mode_runs_and_masks(rng):
+    graph = make_batch(rng)
+    cfg = GTConfig(num_layers=2, dropout_rate=0.0, attention_mode="scatter")
+    (node_out, _), _ = init_and_apply(cfg, graph)
+    assert np.all(np.isfinite(node_out))
+    assert np.abs(np.asarray(node_out)[~np.asarray(graph.node_mask)]).max() == 0.0
+
+
+def test_disable_geometric_mode(rng):
+    graph = make_batch(rng)
+    cfg = GTConfig(num_layers=2, dropout_rate=0.0, disable_geometric_mode=True)
+    (node_out, edge_out), _ = init_and_apply(cfg, graph)
+    assert np.all(np.isfinite(node_out))
+
+
+def test_gradients_finite(rng):
+    graph = make_batch(rng, lengths=(40,), n_pad=64)
+    cfg = GTConfig(num_layers=2, dropout_rate=0.0)
+    model = GeometricTransformer(cfg)
+    node_in = embed_nodes(graph, cfg.hidden)
+    variables = model.init(jax.random.PRNGKey(0), graph, node_in, train=False)
+
+    def loss_fn(params):
+        (node_out, _), _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            graph, node_in, train=True,
+            rngs={"dropout": jax.random.PRNGKey(1)},
+            mutable=["batch_stats"],
+        )
+        return jnp.sum(node_out ** 2)
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(g)) for g in leaves)
+    assert any(np.abs(g).max() > 0 for g in leaves)
+
+
+def test_glorot_orthogonal_variance():
+    w = glorot_orthogonal(2.0)(jax.random.PRNGKey(0), (128, 128))
+    expected = 2.0 / (128 + 128)
+    assert abs(float(jnp.var(w)) - expected) / expected < 1e-3
+
+
+def test_jit_compiles_once(rng):
+    graph = make_batch(rng)
+    cfg = GTConfig(num_layers=2, dropout_rate=0.0, norm_type="layer")
+    model = GeometricTransformer(cfg)
+    node_in = embed_nodes(graph, cfg.hidden)
+    variables = model.init(jax.random.PRNGKey(0), graph, node_in, train=False)
+
+    @jax.jit
+    def fwd(vs, g, x):
+        return model.apply(vs, g, x, train=False)[0]
+
+    out1 = fwd(variables, graph, node_in)
+    out2 = fwd(variables, graph, node_in)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
