@@ -1,0 +1,27 @@
+"""Interaction-tensor construction: two chains' node features -> pair map.
+
+Reference: ``construct_interact_tensor`` (deepinteract_utils.py:158-172)
+interleaves (C, L1) and (C, L2) matrices into a (1, 2C, L1, L2) NCHW tensor.
+We produce NHWC ``[B, L1, L2, 2C]`` (TPU conv-native): channels [:C] are
+chain-1 features broadcast along columns, channels [C:] chain-2 features
+broadcast along rows. Padding is inherent — inputs arrive already padded,
+and the pair mask (outer product of node masks) travels with the tensor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def interaction_tensor(feats1: jnp.ndarray, feats2: jnp.ndarray) -> jnp.ndarray:
+    """[B, L1, C] x [B, L2, C] -> [B, L1, L2, 2C]."""
+    b, l1, c = feats1.shape
+    l2 = feats2.shape[1]
+    a = jnp.broadcast_to(feats1[:, :, None, :], (b, l1, l2, c))
+    bb = jnp.broadcast_to(feats2[:, None, :, :], (b, l1, l2, c))
+    return jnp.concatenate([a, bb], axis=-1)
+
+
+def pair_mask(node_mask1: jnp.ndarray, node_mask2: jnp.ndarray) -> jnp.ndarray:
+    """[B, L1] x [B, L2] -> [B, L1, L2] validity mask."""
+    return node_mask1[:, :, None] & node_mask2[:, None, :]

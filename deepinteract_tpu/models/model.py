@@ -1,0 +1,162 @@
+"""The full siamese network: Geometric Transformer encoder x2 -> interaction
+tensor -> dense 2D decoder -> per-pair contact logits.
+
+Reference: ``LitGINI`` (project/utils/deepinteract_modules.py:1478-2236) —
+here only the network itself; training/optimization/metrics live in
+:mod:`deepinteract_tpu.training`. Both chains share one set of GNN weights
+(siamese; ``shared_step`` applies the same module to graph1 and graph2,
+deepinteract_modules.py:1687-1691).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deepinteract_tpu import constants as C
+from deepinteract_tpu.data.graph import PairedComplex, ProteinGraph
+from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
+from deepinteract_tpu.models.geometric_transformer import GeometricTransformer, GTConfig
+from deepinteract_tpu.models.interaction import interaction_tensor, pair_mask
+from deepinteract_tpu.models.layers import GODense
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Full-network hyperparameters (defaults follow LitGINI defaults,
+    deepinteract_modules.py:1481-1489)."""
+
+    num_node_input_feats: int = C.NUM_NODE_FEATS
+    gnn: GTConfig = dataclasses.field(default_factory=GTConfig)
+    decoder: DecoderConfig = dataclasses.field(default_factory=DecoderConfig)
+    gnn_layer_type: str = "geotran"  # 'geotran' | 'gcn'
+    num_classes: int = C.NUM_CLASSES
+    # Context parallelism: annotate the L1 x L2 interaction map for sharding
+    # over the mesh's 'pair' axis (requires an active mesh context). This is
+    # the distributed form of the reference's 256x256 subsequencing tiles
+    # (deepinteract_utils.py:122-155), SURVEY.md §2.6.
+    shard_pair_map: bool = False
+
+    def __post_init__(self):
+        if self.decoder.in_channels != 2 * self.gnn.hidden:
+            object.__setattr__(
+                self,
+                "decoder",
+                dataclasses.replace(self.decoder, in_channels=2 * self.gnn.hidden),
+            )
+
+
+class GCNStack(nn.Module):
+    """Plain graph-convolution alternative (``--gnn_layer_type gcn``,
+    LitGINI.build_gnn_module/gnn_forward, deepinteract_modules.py:1591-1625,
+    1660-1679): DGL ``GraphConv`` with symmetric degree norm, edge-weighted by
+    the min-max-normalized squared distance (edge feature column 1), no
+    activation between layers."""
+
+    cfg: GTConfig
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, graph: ProteinGraph, node_feats, train: bool = False):
+        w = graph.edge_feats[..., C.EDGE_WEIGHT] * graph.edge_mask()  # [B,N,K]
+        e_mask = graph.edge_mask().astype(node_feats.dtype)
+        # DGL GraphConv(norm='both') normalizes by *unweighted* edge-count
+        # degrees (edge_weight only scales messages; weighted-degree
+        # normalization would require EdgeWeightNorm), and adds a bias.
+        deg_out = jnp.sum(e_mask, axis=-1)  # out-degree at the row owner
+
+        def count_in(m_b, nbr_b):
+            return jax.ops.segment_sum(m_b.reshape(-1), nbr_b.reshape(-1),
+                                       num_segments=m_b.shape[0])
+
+        deg_in = jax.vmap(count_in)(e_mask, graph.nbr_idx)
+        norm_src = jax.lax.rsqrt(jnp.maximum(deg_out, 1e-9))
+        norm_dst = jax.lax.rsqrt(jnp.maximum(deg_in, 1e-9))
+
+        h = node_feats
+        for i in range(self.num_layers):
+            h = GODense(self.cfg.hidden, use_bias=False, name=f"gcn_{i}")(h)
+            hn = h * norm_src[..., None]
+
+            def scatter(h_b, w_b, nbr_b):
+                contrib = h_b[:, None, :] * w_b[..., None]  # [N,K,C] from src rows
+                return jax.ops.segment_sum(
+                    contrib.reshape(-1, h_b.shape[-1]), nbr_b.reshape(-1),
+                    num_segments=h_b.shape[0],
+                )
+
+            h = jax.vmap(scatter)(hn, w, graph.nbr_idx) * norm_dst[..., None]
+            h = h + self.param(f"gcn_bias_{i}", nn.initializers.zeros, (self.cfg.hidden,))
+            h = h * graph.node_mask[..., None]
+        return h, None
+
+
+class DeepInteract(nn.Module):
+    """Siamese GT + interaction decoder. Returns [B, L1, L2, num_classes]
+    logits plus (optionally) learned node representations."""
+
+    cfg: ModelConfig
+
+    def setup(self):
+        gnn_cfg = self.cfg.gnn
+        if self.cfg.num_node_input_feats != gnn_cfg.hidden:
+            self.node_in_embedding = GODense(gnn_cfg.hidden, use_bias=False)
+        else:
+            self.node_in_embedding = None
+        if self.cfg.gnn_layer_type == "gcn":
+            self.gnn = GCNStack(gnn_cfg, num_layers=gnn_cfg.num_layers)
+        else:
+            self.gnn = GeometricTransformer(gnn_cfg)
+        self.decoder = InteractionDecoder(self.cfg.decoder)
+
+    def encode(self, graph: ProteinGraph, train: bool = False):
+        """Shared-weight chain encoder (siamese leg)."""
+        x = jnp.asarray(graph.node_feats)
+        if self.node_in_embedding is not None:
+            x = self.node_in_embedding(x)
+        node_feats, edge_feats = self.gnn(graph, x, train=train)
+        return node_feats, edge_feats
+
+    def __call__(
+        self,
+        graph1: ProteinGraph,
+        graph2: ProteinGraph,
+        train: bool = False,
+        return_representations: bool = False,
+    ):
+        feats1, efeats1 = self.encode(graph1, train=train)
+        feats2, efeats2 = self.encode(graph2, train=train)
+
+        pm = pair_mask(graph1.node_mask, graph2.node_mask)
+        tensor = interaction_tensor(feats1, feats2)
+        if self.cfg.shard_pair_map:
+            from jax.sharding import PartitionSpec as P
+
+            from deepinteract_tpu.parallel.mesh import DATA_AXIS, PAIR_AXIS
+
+            # Leave the batch dim unconstrained (its data-axis sharding flows
+            # from the inputs; pinning it would break batch-1 init traces).
+            spec = P(None, PAIR_AXIS)
+            tensor = jax.lax.with_sharding_constraint(tensor, spec)
+            pm = jax.lax.with_sharding_constraint(pm, spec)
+        logits = self.decoder(tensor, pm, train=train)
+
+        if return_representations:
+            return logits, {
+                "graph1_node_feats": feats1,
+                "graph1_edge_feats": efeats1,
+                "graph2_node_feats": feats2,
+                "graph2_edge_feats": efeats2,
+            }
+        return logits
+
+
+def forward_complex(model: DeepInteract, variables, cx: PairedComplex, train=False, rngs=None,
+                    mutable=()):
+    """Convenience apply() over a PairedComplex."""
+    return model.apply(
+        variables, cx.graph1, cx.graph2, train=train, rngs=rngs, mutable=list(mutable)
+    )

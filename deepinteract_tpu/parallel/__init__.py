@@ -1,0 +1,11 @@
+"""Parallelism: device meshes, sharded train steps, collectives.
+
+Replaces the reference's Lightning DDP / torch.distributed NCCL stack
+(SURVEY.md §2.6) with jax.sharding meshes: a ``data`` axis over protein
+complexes (DDP equivalent) and a ``pair`` axis sharding the L1 x L2
+interaction map (context parallelism over the pair dimension — the
+distributed generalization of the reference's 256x256 subsequencing tiles).
+"""
+
+from deepinteract_tpu.parallel.mesh import make_mesh, shard_batch, replicate  # noqa: F401
+from deepinteract_tpu.parallel.train import make_sharded_train_step  # noqa: F401

@@ -1,0 +1,52 @@
+"""Mesh construction and sharding helpers.
+
+The reference scales with one process per GPU under Lightning DDP
+(``lit_model_train.py:226``); here a single process drives all local devices
+through a ``jax.sharding.Mesh``, and multi-host pods join the same mesh via
+``jax.distributed.initialize`` — collectives ride ICI within a slice and DCN
+across slices without any NCCL/MPI-style process-group management.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+PAIR_AXIS = "pair"
+
+
+def make_mesh(
+    num_data: Optional[int] = None,
+    num_pair: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data, pair) mesh over available devices.
+
+    ``data`` is the DDP-equivalent axis over complexes; ``pair`` shards the
+    interaction map's first residue dimension (context parallelism).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        num_data = len(devices) // num_pair
+    used = num_data * num_pair
+    if used > len(devices):
+        raise ValueError(f"mesh {num_data}x{num_pair} needs {used} devices, have {len(devices)}")
+    arr = np.asarray(devices[:used]).reshape(num_data, num_pair)
+    return Mesh(arr, (DATA_AXIS, PAIR_AXIS))
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a stacked batch pytree with its leading axis split over
+    ``data`` (the per-host sharded-file-list analog of Lightning's
+    DistributedSampler)."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.device_put(batch, sharding)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree (params/opt state) across the mesh."""
+    return jax.device_put(tree, NamedSharding(mesh, P()))

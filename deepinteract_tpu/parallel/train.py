@@ -1,0 +1,48 @@
+"""Sharded training step: pjit-style data + pair-map parallelism.
+
+GSPMD does the heavy lifting: the step function is the *same* pure
+``train_step`` used on one chip; sharding annotations on its inputs make XLA
+insert the gradient reduce (replacing DDP's allreduce) and the halo
+exchanges for pair-axis-sharded decoder convolutions.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepinteract_tpu.parallel.mesh import DATA_AXIS, PAIR_AXIS
+from deepinteract_tpu.training.steps import TrainState, train_step
+
+
+def make_sharded_train_step(mesh: Mesh, weight_classes: bool = False, donate: bool = True):
+    """jit ``train_step`` with state replicated and the batch split over the
+    ``data`` axis. Gradients become pmean automatically through the
+    batch-mean loss under GSPMD.
+    """
+    replicated = NamedSharding(mesh, P())
+    batch_sharded = NamedSharding(mesh, P(DATA_AXIS))
+
+    step = partial(train_step, weight_classes=weight_classes, axis_name=None)
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch_sharded),
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_sharded_eval_step(mesh: Mesh, weight_classes: bool = False):
+    from deepinteract_tpu.training.steps import eval_step
+
+    replicated = NamedSharding(mesh, P())
+    batch_sharded = NamedSharding(mesh, P(DATA_AXIS))
+    step = partial(eval_step, weight_classes=weight_classes)
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch_sharded),
+        out_shardings=None,
+    )

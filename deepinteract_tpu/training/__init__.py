@@ -1,0 +1,5 @@
+"""Training subsystem: objective, optimizer, train state/steps, metrics."""
+
+from deepinteract_tpu.training.objective import contact_loss  # noqa: F401
+from deepinteract_tpu.training.optim import make_optimizer, OptimConfig  # noqa: F401
+from deepinteract_tpu.training.steps import TrainState, create_train_state, train_step, eval_step  # noqa: F401

@@ -1,0 +1,65 @@
+"""Optimizer: AdamW + cosine-annealing warm restarts + gradient clipping.
+
+Reference: ``LitGINI.configure_optimizers`` (deepinteract_modules.py:2189-
+2198) — AdamW(lr=1e-3, weight_decay=1e-2) with
+``CosineAnnealingWarmRestarts(T_0=10)`` (epoch-granular restarts), plus
+Lightning-level grad clipping by norm 0.5 and optional gradient accumulation
+(deepinteract_utils.py:1097-1099).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    lr: float = 1e-3
+    weight_decay: float = 1e-2
+    grad_clip_norm: float = 0.5
+    t0_epochs: int = 10  # first cosine restart period, in epochs
+    t_mult: int = 1  # torch default T_mult=1: equal-length restart cycles
+    eta_min: float = 0.0
+    steps_per_epoch: int = 1000
+    num_epochs: int = 50
+    accumulate_steps: int = 1
+
+
+def cosine_warm_restarts(cfg: OptimConfig) -> optax.Schedule:
+    """CosineAnnealingWarmRestarts as an optax schedule (step-granular)."""
+    cycles = []
+    total = cfg.num_epochs * cfg.steps_per_epoch
+    period = cfg.t0_epochs * cfg.steps_per_epoch
+    while sum(cycles) < total:
+        cycles.append(period)
+        period *= cfg.t_mult if cfg.t_mult > 1 else 1
+    schedules = [
+        optax.cosine_decay_schedule(cfg.lr, decay_steps=c, alpha=cfg.eta_min / cfg.lr)
+        for c in cycles
+    ]
+    boundaries = []
+    acc = 0
+    for c in cycles[:-1]:
+        acc += c
+        boundaries.append(acc)
+    return optax.join_schedules(schedules, boundaries)
+
+
+def make_optimizer(cfg: Optional[OptimConfig] = None) -> optax.GradientTransformation:
+    cfg = cfg or OptimConfig()
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.adamw(
+            learning_rate=cosine_warm_restarts(cfg),
+            b1=0.9,
+            b2=0.999,
+            eps=1e-8,
+            weight_decay=cfg.weight_decay,
+        ),
+    )
+    if cfg.accumulate_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.accumulate_steps)
+    return tx

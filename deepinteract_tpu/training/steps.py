@@ -1,0 +1,105 @@
+"""Train/eval steps: pure jittable functions over a flax TrainState.
+
+Replaces PyTorch Lightning's training loop machinery
+(``LitGINI.training_step``/``validation_step``, deepinteract_modules.py:
+1756-2016) with compact functional steps designed for ``jax.jit`` /
+``shard_map``: params + batch stats in one state pytree, dropout rng folded
+per step, donated state for in-place HBM updates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.training import train_state
+
+from deepinteract_tpu.data.graph import PairedComplex
+from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+from deepinteract_tpu.training.objective import contact_loss
+from deepinteract_tpu.training.optim import OptimConfig, make_optimizer
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = None
+    dropout_rng: jax.Array = None
+
+
+def create_train_state(
+    model: DeepInteract,
+    example: PairedComplex,
+    seed: int = 42,
+    optim_cfg: Optional[OptimConfig] = None,
+) -> TrainState:
+    """Initialize parameters and optimizer state (reference seed 42 default,
+    deepinteract_utils.py:1118-1122)."""
+    root = jax.random.PRNGKey(seed)
+    params_rng, dropout_rng = jax.random.split(root)
+    variables = model.init(
+        {"params": params_rng, "dropout": dropout_rng},
+        example.graph1,
+        example.graph2,
+        train=False,
+    )
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=make_optimizer(optim_cfg),
+        batch_stats=variables.get("batch_stats", {}),
+        dropout_rng=dropout_rng,
+    )
+
+
+def loss_and_updates(params, state: TrainState, batch: PairedComplex, weight_classes: bool,
+                     dropout_rng):
+    outputs, mutated = state.apply_fn(
+        {"params": params, "batch_stats": state.batch_stats},
+        batch.graph1,
+        batch.graph2,
+        train=True,
+        rngs={"dropout": dropout_rng},
+        mutable=["batch_stats"],
+    )
+    loss = contact_loss(outputs, batch.contact_map, batch.pair_mask, weight_classes)
+    return loss, mutated
+
+
+def train_step(
+    state: TrainState,
+    batch: PairedComplex,
+    weight_classes: bool = False,
+    axis_name: Optional[str] = None,
+) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+    """One optimization step. When ``axis_name`` is set (inside pmap /
+    shard_map), gradients and metrics are psum-averaged across the data axis
+    — the XLA-collective equivalent of DDP's gradient all-reduce
+    (SURVEY.md §2.6)."""
+    dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+    grad_fn = jax.value_and_grad(loss_and_updates, has_aux=True)
+    (loss, mutated), grads = grad_fn(state.params, state, batch, weight_classes, dropout_rng)
+    if axis_name is not None:
+        grads = jax.lax.pmean(grads, axis_name)
+        loss = jax.lax.pmean(loss, axis_name)
+    new_state = state.apply_gradients(
+        grads=grads, batch_stats=mutated.get("batch_stats", state.batch_stats)
+    )
+    metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+    return new_state, metrics
+
+
+def eval_step(
+    state: TrainState, batch: PairedComplex, weight_classes: bool = False
+) -> Dict[str, jnp.ndarray]:
+    """Forward pass + loss + per-pair probabilities (no param update)."""
+    logits = state.apply_fn(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        batch.graph1,
+        batch.graph2,
+        train=False,
+    )
+    loss = contact_loss(logits, batch.contact_map, batch.pair_mask, weight_classes)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return {"loss": loss, "probs": probs, "logits": logits}
