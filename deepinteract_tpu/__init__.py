@@ -12,8 +12,8 @@ This is a ground-up TPU-first redesign, not a port:
   (data-parallel axis over complexes, context-parallel axis over the
   L1 x L2 pair map) with XLA collectives over ICI — replacing the
   reference's Lightning DDP / NCCL stack.
-* The edge-softmax/aggregation hot loop is a dense fused op with a Pallas
-  TPU kernel path (see ``deepinteract_tpu.ops``).
+* The edge-softmax/aggregation hot loop is a dense fused op
+  (see ``deepinteract_tpu.ops``).
 
 Reference layout citations in docstrings point into the upstream repo
 (``/root/reference``) for parity checking.

@@ -1,0 +1,6 @@
+"""User-facing CLIs: train / test / predict (+ data tooling).
+
+Replaces the reference entry points ``project/lit_model_train.py``,
+``lit_model_test.py``, ``lit_model_predict.py`` and their three-stage
+argparse stack (``collect_args``, deepinteract_utils.py:1003-1110).
+"""

@@ -1,0 +1,174 @@
+"""Shared argument surface: one argparse builder over the framework's
+frozen dataclass configs.
+
+Reference: the three-stage arg system — ``collect_args``
+(deepinteract_utils.py:1003-1110), ``LitGINI.add_model_specific_args``
+(deepinteract_modules.py:2200-2236), and per-script Trainer-field
+translation (lit_model_train.py:207-226). Here one builder produces the
+same knobs grouped the same way, and ``configs_from_args`` materializes
+the typed configs the library consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Tuple
+
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import ModelConfig
+from deepinteract_tpu.training.loop import LoopConfig
+from deepinteract_tpu.training.optim import OptimConfig
+
+
+def add_data_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("data")
+    g.add_argument("--dips_root", type=str, default=None,
+                   help="DIPS-Plus npz root (with processed/ and split files)")
+    g.add_argument("--db5_root", type=str, default=None)
+    g.add_argument("--casp_capri_root", type=str, default=None)
+    g.add_argument("--train_with_db5", action="store_true",
+                   help="train/val on DB5-Plus instead of DIPS-Plus")
+    g.add_argument("--test_with_casp_capri", action="store_true")
+    g.add_argument("--percent_to_use", type=float, default=1.0)
+    g.add_argument("--split_ver", type=str, default=None)
+    g.add_argument("--input_indep", action="store_true",
+                   help="zero all input features (scientific control, "
+                        "deepinteract_utils.py:968-974)")
+    g.add_argument("--batch_size", type=int, default=1)
+    g.add_argument("--pad_to_max_bucket", action="store_true",
+                   help="pad every chain to the top bucket (one compile)")
+
+
+def add_model_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("model")
+    g.add_argument("--gnn_layer_type", choices=("geotran", "gcn"), default="geotran")
+    g.add_argument("--num_gnn_layers", type=int, default=2)
+    g.add_argument("--num_gnn_hidden_channels", type=int, default=128)
+    g.add_argument("--num_gnn_attention_heads", type=int, default=4)
+    g.add_argument("--num_interact_layers", type=int, default=14,
+                   help="decoder ResNet chunks")
+    g.add_argument("--num_interact_hidden_channels", type=int, default=128)
+    g.add_argument("--use_interact_attention", action="store_true")
+    g.add_argument("--dropout_rate", type=float, default=0.2)
+    g.add_argument("--attention_mode", choices=("scatter", "gather"), default="scatter",
+                   help="scatter = reference-exact edge softmax; gather = "
+                        "TPU-fast out-edge approximation")
+    g.add_argument("--disable_geometric_mode", action="store_true")
+    g.add_argument("--norm_type", choices=("batch", "layer"), default="batch")
+    g.add_argument("--tile_pair_map", action="store_true",
+                   help="blockwise long-context decoding (models/tiled.py)")
+    g.add_argument("--shard_pair_map", action="store_true",
+                   help="context-parallel pair-map sharding over the mesh")
+
+
+def add_training_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("training")
+    g.add_argument("--lr", type=float, default=1e-3)
+    g.add_argument("--weight_decay", type=float, default=1e-2)
+    g.add_argument("--grad_clip_norm", type=float, default=0.5)
+    g.add_argument("--num_epochs", type=int, default=50)
+    g.add_argument("--accumulate_grad_batches", type=int, default=1)
+    g.add_argument("--patience", type=int, default=5)
+    g.add_argument("--min_delta", type=float, default=5e-6)
+    g.add_argument("--metric_to_track", type=str, default="val_ce")
+    g.add_argument("--ckpt_dir", type=str, default="checkpoints")
+    g.add_argument("--ckpt_name", type=str, default=None,
+                   help="restore/fine-tune source checkpoint directory")
+    g.add_argument("--fine_tune", action="store_true",
+                   help="warm-start from --ckpt_name and freeze the decoder "
+                        "(deepinteract_modules.py:1546-1557)")
+    g.add_argument("--resume", action="store_true")
+    g.add_argument("--weight_classes", action="store_true",
+                   help="1:5 positive class weighting "
+                        "(deepinteract_modules.py:1781-1787)")
+    g.add_argument("--pos_prob_threshold", type=float, default=0.5)
+    g.add_argument("--seed", type=int, default=42)
+    g.add_argument("--max_hours", type=float, default=None)
+    g.add_argument("--num_devices", type=int, default=0,
+                   help="data-parallel devices (0 = single-device, no mesh)")
+    g.add_argument("--num_pair_shards", type=int, default=1,
+                   help="context-parallel shards of the pair map")
+
+
+def add_logging_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("logging")
+    g.add_argument("--experiment_name", type=str, default=None)
+    g.add_argument("--tb_log_dir", type=str, default=None,
+                   help="TensorBoard scalar log directory")
+    g.add_argument("--profile_dir", type=str, default=None,
+                   help="capture a jax.profiler trace of the first train "
+                        "epoch into this directory")
+    g.add_argument("--log_every", type=int, default=100)
+
+
+def build_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    add_data_args(p)
+    add_model_args(p)
+    add_training_args(p)
+    add_logging_args(p)
+    return p
+
+
+def configs_from_args(
+    args: argparse.Namespace,
+) -> Tuple[ModelConfig, OptimConfig, LoopConfig]:
+    gnn = GTConfig(
+        num_layers=args.num_gnn_layers,
+        hidden=args.num_gnn_hidden_channels,
+        num_heads=args.num_gnn_attention_heads,
+        dropout_rate=args.dropout_rate,
+        attention_mode=args.attention_mode,
+        disable_geometric_mode=args.disable_geometric_mode,
+        norm_type=args.norm_type,
+    )
+    decoder = DecoderConfig(
+        num_chunks=args.num_interact_layers,
+        num_channels=args.num_interact_hidden_channels,
+        use_attention=args.use_interact_attention,
+        dropout_rate=args.dropout_rate,
+    )
+    model_cfg = ModelConfig(
+        gnn=gnn,
+        decoder=decoder,
+        gnn_layer_type=args.gnn_layer_type,
+        shard_pair_map=args.shard_pair_map or args.num_pair_shards > 1,
+        tile_pair_map=args.tile_pair_map,
+    )
+    optim_cfg = OptimConfig(
+        lr=args.lr,
+        weight_decay=args.weight_decay,
+        grad_clip_norm=args.grad_clip_norm,
+        num_epochs=args.num_epochs,
+        accumulate_steps=args.accumulate_grad_batches,
+    )
+    loop_cfg = LoopConfig(
+        num_epochs=args.num_epochs,
+        metric_to_track=args.metric_to_track,
+        patience=args.patience,
+        min_delta=args.min_delta,
+        ckpt_dir=args.ckpt_dir,
+        seed=args.seed,
+        weight_classes=args.weight_classes,
+        pos_prob_threshold=args.pos_prob_threshold,
+        log_every=args.log_every,
+        max_time_seconds=args.max_hours * 3600 if args.max_hours else None,
+    )
+    return model_cfg, optim_cfg, loop_cfg
+
+
+def make_mesh_from_args(args) -> Optional[object]:
+    if getattr(args, "num_devices", 0) and args.num_devices > 0:
+        from deepinteract_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(num_data=args.num_devices, num_pair=args.num_pair_shards)
+    return None
+
+
+def make_metric_writer(args):
+    if getattr(args, "tb_log_dir", None):
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter(args.tb_log_dir)
+    return None

@@ -1,0 +1,94 @@
+"""Predict CLI — reference ``project/lit_model_predict.py`` equivalent.
+
+Takes one complex (an ``.npz`` in our format — produced by the converter or
+the featurization pipeline), restores a checkpoint, and writes:
+
+* ``contact_prob_map.npy``      — [n1, n2] positive-class softmax map
+* ``graph1_node_feats.npy`` / ``graph2_node_feats.npy``
+* ``graph1_edge_feats.npy`` / ``graph2_edge_feats.npy``
+
+matching the reference's artifact set (lit_model_predict.py:235-260, which
+saves the contact probability map plus the four learned representation
+arrays). Untrained prediction (no checkpoint) is allowed for smoke tests.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from deepinteract_tpu.cli.args import build_parser, configs_from_args
+
+
+def main(argv=None) -> int:
+    parser = build_parser(__doc__)
+    parser.add_argument("--input_npz", type=str, required=True,
+                        help="complex .npz (see deepinteract_tpu.data.io)")
+    parser.add_argument("--output_dir", type=str, default=".")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from deepinteract_tpu.data.io import load_complex_npz, to_paired_complex
+    from deepinteract_tpu.data.graph import stack_complexes
+    from deepinteract_tpu.models.model import DeepInteract
+    from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig
+    from deepinteract_tpu.training.loop import Trainer, state_to_tree
+
+    model_cfg, optim_cfg, loop_cfg = configs_from_args(args)
+
+    raw = load_complex_npz(args.input_npz)
+    n1 = raw["graph1"]["node_feats"].shape[0]
+    n2 = raw["graph2"]["node_feats"].shape[0]
+    batch = stack_complexes([to_paired_complex(raw, input_indep=args.input_indep)])
+
+    model = DeepInteract(model_cfg)
+    trainer = Trainer(model, loop_cfg, optim_cfg)
+    state = trainer.init_state(batch)
+    if args.ckpt_name:
+        ckpt = Checkpointer(CheckpointConfig(directory=args.ckpt_name,
+                                             metric_to_track=args.metric_to_track))
+        tree = state_to_tree(state)
+        restored = ckpt.restore({"params": tree["params"],
+                                 "batch_stats": tree["batch_stats"]},
+                                which="best", partial=True)
+        ckpt.close()
+        state = state.replace(params=restored["params"],
+                              batch_stats=restored["batch_stats"])
+
+    logits, reps = jax.jit(
+        lambda p, bs, g1, g2: model.apply(
+            {"params": p, "batch_stats": bs}, g1, g2,
+            train=False, return_representations=True,
+        )
+    )(state.params, state.batch_stats, batch.graph1, batch.graph2)
+
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0, :n1, :n2, 1]
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    out = os.path.join(args.output_dir, "contact_prob_map.npy")
+    np.save(out, probs)
+    k1 = batch.graph1.knn
+    k2 = batch.graph2.knn
+    saved = [out]
+    for name, arr, n, k in (
+        ("graph1_node_feats", reps["graph1_node_feats"], n1, None),
+        ("graph2_node_feats", reps["graph2_node_feats"], n2, None),
+        ("graph1_edge_feats", reps["graph1_edge_feats"], n1, k1),
+        ("graph2_edge_feats", reps["graph2_edge_feats"], n2, k2),
+    ):
+        if arr is None:
+            continue
+        a = np.asarray(arr)[0]
+        a = a[:n] if k is None else a[:n, :k]
+        path = os.path.join(args.output_dir, f"{name}.npy")
+        np.save(path, a)
+        saved.append(path)
+    print("saved:", ", ".join(saved))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Test/eval CLI — reference ``project/lit_model_test.py`` equivalent.
+
+Restores a checkpoint, runs the held-out test pass (DIPS-Plus test n=32,
+DB5-Plus test n=55, or CASP-CAPRI n=19) with the reference's test-time
+metric conventions (L = min(n1, n2), deepinteract_modules.py:2045), writes
+the per-target top-k CSV, and prints median metrics.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from deepinteract_tpu.cli.args import build_parser, configs_from_args, make_mesh_from_args
+
+
+def main(argv=None) -> int:
+    parser = build_parser(__doc__)
+    parser.add_argument("--csv_out", type=str, default=None,
+                        help="per-target CSV path (default mirrors the "
+                             "reference naming, deepinteract_modules.py:2139-2143)")
+    args = parser.parse_args(argv)
+
+    from deepinteract_tpu.data.datasets import PICPDataModule
+    from deepinteract_tpu.data.loader import BucketedLoader
+    from deepinteract_tpu.models.model import DeepInteract
+    from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig
+    from deepinteract_tpu.training.loop import Trainer, state_to_tree
+
+    model_cfg, optim_cfg, loop_cfg = configs_from_args(args)
+    dm = PICPDataModule(
+        dips_root=args.dips_root,
+        db5_root=args.db5_root,
+        casp_capri_root=args.casp_capri_root,
+        train_with_db5=args.train_with_db5,
+        test_with_casp_capri=args.test_with_casp_capri,
+        input_indep=args.input_indep,
+        split_ver=args.split_ver,
+        seed=args.seed,
+    )
+    test_loader = BucketedLoader(dm.test, batch_size=1)
+
+    model = DeepInteract(model_cfg)
+    trainer = Trainer(model, loop_cfg, optim_cfg, mesh=make_mesh_from_args(args))
+    state = trainer.init_state(next(iter(test_loader)))
+
+    ckpt_dir = args.ckpt_name or args.ckpt_dir
+    ckpt = Checkpointer(CheckpointConfig(directory=ckpt_dir,
+                                         metric_to_track=args.metric_to_track))
+    tree = state_to_tree(state)
+    restored = ckpt.restore({"params": tree["params"],
+                             "batch_stats": tree["batch_stats"]},
+                            which="best", partial=True)
+    ckpt.close()
+    state = state.replace(params=restored["params"], batch_stats=restored["batch_stats"])
+
+    # Reference CSV naming (deepinteract_modules.py:2139-2143).
+    if args.csv_out:
+        csv_path = args.csv_out
+    elif args.test_with_casp_capri:
+        csv_path = "casp_capri_top_metrics.csv"
+    elif args.train_with_db5:
+        csv_path = "db5_plus_test_top_metrics.csv"
+    else:
+        csv_path = "dips_plus_test_top_metrics.csv"
+
+    metrics = trainer.evaluate(
+        state, test_loader, stage="test", targets=test_loader.targets(),
+        csv_path=csv_path,
+    )
+    for key in sorted(metrics):
+        print(f"{key}: {metrics[key]:.6f}")
+    print(f"wrote {csv_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,171 @@
+"""Dataset classes: DIPS-Plus, DB5-Plus, CASP-CAPRI over the npz tree.
+
+Mirrors the reference's split handling (``DIPSDGLDataset`` et al.,
+project/datasets/DIPS/dips_dgl_dataset.py:76-271) without DGL's dataset
+machinery: a root directory holds ``processed/`` (npz complexes, see
+``data.io``) and split list files ``pairs-postprocessed-{mode}.txt`` (one
+relative path per line, same naming as the reference). Features:
+
+* ``percent_to_use`` subsampling with a persisted sample file so re-runs see
+  the same subset (reference ``construct_filenames_frame_txt_filenames``,
+  deepinteract_utils.py:87-100).
+* ``input_indep`` zero-feature ablation (deepinteract_utils.py:968-974).
+* ``train_viz`` mode repeating the first complex (dips_dgl_dataset.py:139-143).
+* Lazy per-item loading; items are unpadded raw dicts, padded/bucketed by
+  the loader (TPU needs shape buckets, not per-item shapes).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Dict, List, Optional
+
+from deepinteract_tpu import constants
+from deepinteract_tpu.data.io import complex_lengths, load_complex_npz
+
+
+class ComplexDataset:
+    """File-list driven dataset of npz complexes."""
+
+    name = "generic"
+    num_node_features = constants.NUM_NODE_FEATS
+    num_edge_features = constants.NUM_EDGE_FEATS
+    num_classes = constants.NUM_CLASSES
+
+    def __init__(
+        self,
+        root: str,
+        mode: str = "train",
+        percent_to_use: float = 1.0,
+        input_indep: bool = False,
+        train_viz: bool = False,
+        split_ver: Optional[str] = None,
+        seed: int = 42,
+    ):
+        assert mode in ("train", "val", "test"), mode
+        assert 0.0 < percent_to_use <= 1.0
+        self.root = root
+        self.mode = mode
+        self.input_indep = input_indep
+        self.processed_dir = os.path.join(root, "processed")
+        self.filenames = self._resolve_filenames(mode, percent_to_use, split_ver, seed)
+        if train_viz:
+            # Reference: repeat the first complex so every data-parallel
+            # rank sees the same viz sample (dips_dgl_dataset.py:139-143).
+            self.filenames = [self.filenames[0]] * max(len(self.filenames), 1)
+
+    def _split_file(self, mode: str, split_ver: Optional[str]) -> str:
+        base = f"pairs-postprocessed-{mode}.txt"
+        if split_ver:
+            return os.path.join(self.root, split_ver, base)
+        return os.path.join(self.root, base)
+
+    def _resolve_filenames(
+        self, mode: str, percent: float, split_ver: Optional[str], seed: int
+    ) -> List[str]:
+        split_path = self._split_file(mode, split_ver)
+        if not os.path.exists(split_path):
+            raise FileNotFoundError(
+                f"{type(self).__name__}: missing split file {split_path}"
+            )
+        with open(split_path) as f:
+            names = [line.strip() for line in f if line.strip()]
+        if percent < 1.0:
+            # Persist the sampled subset next to the split file (reference
+            # behavior: sampled filename frames are written once and reused).
+            sampled_path = split_path.replace(".txt", f"-{int(percent * 100)}%.txt")
+            if os.path.exists(sampled_path):
+                with open(sampled_path) as f:
+                    names = [line.strip() for line in f if line.strip()]
+            else:
+                rng = random.Random(seed)
+                names = rng.sample(names, max(1, int(len(names) * percent)))
+                with open(sampled_path, "w") as f:
+                    f.write("\n".join(names) + "\n")
+        return names
+
+    def __len__(self) -> int:
+        return len(self.filenames)
+
+    def path_of(self, idx: int) -> str:
+        rel = os.path.splitext(self.filenames[idx])[0] + ".npz"
+        return os.path.join(self.processed_dir, rel)
+
+    def target_of(self, idx: int) -> str:
+        return os.path.splitext(os.path.basename(self.filenames[idx]))[0]
+
+    def __getitem__(self, idx: int) -> Dict:
+        raw = load_complex_npz(self.path_of(idx))
+        raw["input_indep"] = self.input_indep
+        raw["target"] = self.target_of(idx)
+        return raw
+
+    def lengths(self) -> List[tuple]:
+        """(n1, n2) per item, reading only headers (cheap bucket planning)."""
+        out = []
+        for i in range(len(self)):
+            raw = load_complex_npz(self.path_of(i))
+            out.append(complex_lengths(raw))
+        return out
+
+
+class DIPSDataset(ComplexDataset):
+    """DIPS-Plus: 15,618 train / 3,548 val / 32 test complexes
+    (dips_dgl_dataset.py:22-30)."""
+
+    name = "DIPS-Plus"
+
+
+class DB5Dataset(ComplexDataset):
+    """DB5-Plus: 140 train / 35 val / 55 test unbound dimers
+    (db5_dgl_dataset.py:16-24). Test batch size is forced to 1 by the data
+    module (picp_dgl_data_module.py:146-157)."""
+
+    name = "DB5-Plus"
+
+
+class CASPCAPRIDataset(ComplexDataset):
+    """CASP-CAPRI 13/14: 19 test-only dimers, 14 homo + 5 hetero
+    (casp_capri_dgl_dataset.py:16-23)."""
+
+    name = "CASP-CAPRI"
+
+    def __init__(self, root: str, mode: str = "test", **kw):
+        assert mode == "test", "CASP-CAPRI is a test-only dataset"
+        super().__init__(root, mode=mode, **kw)
+
+
+class PICPDataModule:
+    """Composite protein-interface-contact-prediction data source
+    (reference ``PICPDGLDataModule``, picp_dgl_data_module.py:71-157):
+    train/val on DIPS-Plus or DB5-Plus, test on DIPS-Plus or CASP-CAPRI."""
+
+    def __init__(
+        self,
+        dips_root: Optional[str] = None,
+        db5_root: Optional[str] = None,
+        casp_capri_root: Optional[str] = None,
+        train_with_db5: bool = False,
+        test_with_casp_capri: bool = False,
+        percent_to_use: float = 1.0,
+        input_indep: bool = False,
+        split_ver: Optional[str] = None,
+        seed: int = 42,
+    ):
+        kw = dict(percent_to_use=percent_to_use, input_indep=input_indep, seed=seed)
+        if train_with_db5:
+            assert db5_root, "train_with_db5 requires db5_root"
+            self.train = DB5Dataset(db5_root, mode="train", **kw)
+            self.val = DB5Dataset(db5_root, mode="val", **kw)
+        else:
+            assert dips_root, "training requires dips_root"
+            self.train = DIPSDataset(dips_root, mode="train", split_ver=split_ver, **kw)
+            self.val = DIPSDataset(dips_root, mode="val", split_ver=split_ver, **kw)
+        if test_with_casp_capri:
+            assert casp_capri_root, "test_with_casp_capri requires casp_capri_root"
+            self.test = CASPCAPRIDataset(casp_capri_root, input_indep=input_indep)
+        elif train_with_db5:
+            self.test = DB5Dataset(db5_root, mode="test", **kw)
+        else:
+            self.test = DIPSDataset(dips_root, mode="test", split_ver=split_ver, **kw)

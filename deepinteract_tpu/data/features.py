@@ -198,6 +198,13 @@ def sample_geo_neighborhoods(nbr_idx: np.ndarray, geo_nbrhd_size: int, rng: np.r
     ``deepinteract_utils.py:532-553`` (flat edge id of (i, k) is i*K + k);
     see ``graph.ProteinGraph`` for the documented in-edge -> out-edge
     deviation.
+
+    Distributional note: the permutation over row i's K slots can select the
+    edge's *own* slot k as one of its "neighboring" edges, and for a
+    self-loop edge (j == i) the dst-side draw samples the same row as the
+    src side. The reference samples from shuffled in-edge lists, where the
+    same degenerate picks occur but with a different distribution; exact
+    sampling parity is not a goal (this runs once, in data prep).
     """
     n, k = nbr_idx.shape
     g = geo_nbrhd_size

@@ -1,0 +1,108 @@
+"""On-disk complex format: one compressed ``.npz`` per complex.
+
+TPU-native replacement for the reference's pickled DGL-graph dicts
+(``process_complex_into_dict``, deepinteract_utils.py:924-965): plain numpy
+arrays keyed by chain, loadable with zero framework dependencies, padded to
+shape buckets only at load time so one file serves every bucket policy.
+
+Schema (unpadded):
+  g{1,2}_node_feats [N,113], g{1,2}_coords [N,3], g{1,2}_edge_feats [N,K,28],
+  g{1,2}_nbr_idx [N,K], g{1,2}_src_nbr_eids / _dst_nbr_eids [N,K,G],
+  examples [M,3] (i, j, label over ALL chain1 x chain2 pairs, reference
+  ``build_examples_tensor`` deepinteract_utils.py:558-582),
+  complex_name (str).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from deepinteract_tpu.data.graph import PairedComplex, pad_graph, pick_bucket
+
+GRAPH_KEYS = ("node_feats", "coords", "edge_feats", "nbr_idx", "src_nbr_eids", "dst_nbr_eids")
+
+
+def save_complex_npz(
+    path: str,
+    raw1: Dict[str, np.ndarray],
+    raw2: Dict[str, np.ndarray],
+    examples: np.ndarray,
+    complex_name: str = "",
+) -> None:
+    payload = {}
+    for prefix, raw in (("g1", raw1), ("g2", raw2)):
+        for key in GRAPH_KEYS:
+            payload[f"{prefix}_{key}"] = np.asarray(raw[key])
+    payload["examples"] = np.asarray(examples, dtype=np.int32)
+    payload["complex_name"] = np.asarray(complex_name)
+    np.savez_compressed(path, **payload)
+
+
+def load_complex_npz(path: str) -> Dict:
+    with np.load(path, allow_pickle=False) as z:
+        raw1 = {key: z[f"g1_{key}"] for key in GRAPH_KEYS}
+        raw2 = {key: z[f"g2_{key}"] for key in GRAPH_KEYS}
+        return {
+            "graph1": raw1,
+            "graph2": raw2,
+            "examples": z["examples"],
+            "complex_name": str(z["complex_name"]),
+        }
+
+
+def examples_to_contact_map(examples: np.ndarray, n1: int, n2: int) -> np.ndarray:
+    """Dense 0/1 [n1, n2] map from the flattened (i, j, label) example list
+    (inverse of the reference's ``build_examples_matrix_using_multi_indexing``)."""
+    m = np.zeros((n1, n2), dtype=np.int32)
+    m[examples[:, 0], examples[:, 1]] = examples[:, 2]
+    return m
+
+
+def to_paired_complex(
+    raw: Dict,
+    n_pad1: Optional[int] = None,
+    n_pad2: Optional[int] = None,
+    input_indep: bool = False,
+) -> PairedComplex:
+    """Pad a loaded complex into model-ready arrays.
+
+    ``input_indep`` zeroes all node/edge input features — the reference's
+    input-independence scientific control (``zero_out_complex_features``,
+    deepinteract_utils.py:968-974).
+    """
+    raw1, raw2 = raw["graph1"], raw["graph2"]
+    if input_indep:
+        raw1 = dict(raw1, node_feats=np.zeros_like(raw1["node_feats"]),
+                    edge_feats=np.zeros_like(raw1["edge_feats"]))
+        raw2 = dict(raw2, node_feats=np.zeros_like(raw2["node_feats"]),
+                    edge_feats=np.zeros_like(raw2["edge_feats"]))
+    n1 = raw1["node_feats"].shape[0]
+    n2 = raw2["node_feats"].shape[0]
+    p1 = n_pad1 or pick_bucket(n1)
+    p2 = n_pad2 or pick_bucket(n2)
+    g1 = pad_graph(raw1, p1)
+    g2 = pad_graph(raw2, p2)
+
+    examples = np.asarray(raw["examples"], dtype=np.int32)
+    contact_map = np.zeros((p1, p2), dtype=np.int32)
+    contact_map[:n1, :n2] = examples_to_contact_map(examples, n1, n2)
+
+    m_pad = p1 * p2
+    examples_padded = np.zeros((m_pad, 3), dtype=np.int32)
+    example_mask = np.zeros(m_pad, dtype=bool)
+    examples_padded[: examples.shape[0]] = examples
+    example_mask[: examples.shape[0]] = True
+
+    return PairedComplex(
+        graph1=g1,
+        graph2=g2,
+        examples=examples_padded,
+        example_mask=example_mask,
+        contact_map=contact_map,
+    )
+
+
+def complex_lengths(raw: Dict) -> Tuple[int, int]:
+    return raw["graph1"]["node_feats"].shape[0], raw["graph2"]["node_feats"].shape[0]

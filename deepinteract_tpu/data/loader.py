@@ -1,0 +1,147 @@
+"""Bucketing batch loader: shape-stable batches for XLA.
+
+Replaces the reference's DataLoader + ``dgl_picp_collate``
+(deepinteract_utils.py:61-67). DGL concatenates variable-size graphs; XLA
+wants a handful of static shapes, so complexes are grouped by their
+(bucket1, bucket2) padded chain lengths (``pick_bucket`` over
+``constants.CHAIN_LENGTH_BUCKETS``) and only same-bucket complexes batch
+together — each distinct bucket pair compiles once, then every epoch reuses
+the executable.
+
+For data parallelism, ``batch_size`` should be a multiple of the mesh's
+data-axis size; ``drop_remainder=True`` (train) keeps every step full and
+shape-stable, while eval keeps remainders as smaller (still bucketed)
+batches.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepinteract_tpu.data.graph import PairedComplex, pick_bucket, stack_complexes
+from deepinteract_tpu.data.io import to_paired_complex
+
+
+class BucketedLoader:
+    """Iterable of stacked ``PairedComplex`` batches.
+
+    Conforms to the Trainer's DataSource protocol: calling the loader with
+    an epoch number returns a fresh (re-shuffled) iterator; iterating the
+    object directly uses epoch 0 ordering.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_remainder: bool = False,
+        seed: int = 42,
+        pad_to_max_bucket: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self.seed = seed
+        self.pad_to_max_bucket = pad_to_max_bucket
+        # Bucket planning reads every header once, up front.
+        self._buckets = self._plan()
+
+    def _item_bucket(self, n1: int, n2: int) -> Tuple[int, int]:
+        if self.pad_to_max_bucket:
+            from deepinteract_tpu import constants
+
+            top = constants.CHAIN_LENGTH_BUCKETS[-1]
+            return (max(pick_bucket(n1), top), max(pick_bucket(n2), top))
+        return (pick_bucket(n1), pick_bucket(n2))
+
+    def _plan(self) -> Dict[Tuple[int, int], List[int]]:
+        buckets: Dict[Tuple[int, int], List[int]] = defaultdict(list)
+        for idx, (n1, n2) in enumerate(self.dataset.lengths()):
+            buckets[self._item_bucket(n1, n2)].append(idx)
+        return dict(buckets)
+
+    def num_batches(self) -> int:
+        total = 0
+        for indices in self._buckets.values():
+            if self.drop_remainder:
+                total += len(indices) // self.batch_size
+            else:
+                total += (len(indices) + self.batch_size - 1) // self.batch_size
+        return total
+
+    def _epoch_plan(self, epoch: int) -> List[Tuple[Tuple[int, int], List[int]]]:
+        plan = []
+        rng = random.Random(self.seed + epoch) if self.shuffle else None
+        for bucket, indices in sorted(self._buckets.items()):
+            idxs = list(indices)
+            if rng:
+                rng.shuffle(idxs)
+            for i in range(0, len(idxs), self.batch_size):
+                chunk = idxs[i : i + self.batch_size]
+                if len(chunk) < self.batch_size and self.drop_remainder:
+                    continue
+                plan.append((bucket, chunk))
+        if rng:
+            rng.shuffle(plan)  # interleave buckets across the epoch
+        return plan
+
+    def iter_epoch(self, epoch: int = 0, with_targets: bool = False) -> Iterator:
+        for (b1, b2), chunk in self._epoch_plan(epoch):
+            complexes, targets = [], []
+            for idx in chunk:
+                raw = self.dataset[idx]
+                complexes.append(
+                    to_paired_complex(
+                        raw, n_pad1=b1, n_pad2=b2,
+                        input_indep=raw.get("input_indep", False),
+                    )
+                )
+                targets.append(raw.get("target", str(idx)))
+            batch = stack_complexes(complexes)
+            yield (batch, targets) if with_targets else batch
+
+    def targets(self) -> List[str]:
+        """Target names in epoch-0 iteration order (for eval CSV export)."""
+        out = []
+        for _, chunk in self._epoch_plan(0):
+            out.extend(self.dataset.target_of(i) for i in chunk)
+        return out
+
+    def __call__(self, epoch: int) -> Iterator[PairedComplex]:
+        return self.iter_epoch(epoch)
+
+    def __iter__(self) -> Iterator[PairedComplex]:
+        return self.iter_epoch(0)
+
+
+class InMemoryDataset:
+    """Adapter giving a list of raw complex dicts the dataset protocol
+    (tests, synthetic data, and single-complex prediction)."""
+
+    def __init__(self, raws: Sequence[Dict], targets: Optional[Sequence[str]] = None):
+        self.raws = list(raws)
+        self._targets = list(targets) if targets else [f"complex_{i}" for i in range(len(raws))]
+
+    def __len__(self):
+        return len(self.raws)
+
+    def __getitem__(self, idx):
+        raw = dict(self.raws[idx])
+        raw.setdefault("input_indep", False)
+        raw["target"] = self._targets[idx]
+        return raw
+
+    def target_of(self, idx):
+        return self._targets[idx]
+
+    def lengths(self):
+        return [
+            (r["graph1"]["node_feats"].shape[0], r["graph2"]["node_feats"].shape[0])
+            for r in self.raws
+        ]

@@ -118,7 +118,9 @@ class MaskedBatchNorm(nn.Module):
                 unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
                 ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
         y = (x - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
-        return jnp.where(mask[..., None], y, x)
+        # Zero padded slots (don't pass raw values through): downstream code
+        # may read intermediate features without re-masking.
+        return jnp.where(mask[..., None], y, 0.0)
 
 
 class FeatureNorm(nn.Module):

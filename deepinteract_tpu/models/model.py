@@ -39,13 +39,22 @@ class ModelConfig:
     # the distributed form of the reference's 256x256 subsequencing tiles
     # (deepinteract_utils.py:122-155), SURVEY.md §2.6.
     shard_pair_map: bool = False
+    # Long-context tier: decode the pair map in tile_size x tile_size blocks
+    # via lax.scan so the full interaction tensor is never materialized
+    # (reference subsequencing, deepinteract_utils.py:122-155,184-308 — see
+    # models/tiled.py). Engages only when the padded map exceeds one tile.
+    tile_pair_map: bool = False
+    tile_size: int = C.PAIR_MAP_TILE
 
     def __post_init__(self):
+        updates = {}
         if self.decoder.in_channels != 2 * self.gnn.hidden:
+            updates["in_channels"] = 2 * self.gnn.hidden
+        if self.decoder.num_classes != self.num_classes:
+            updates["num_classes"] = self.num_classes
+        if updates:
             object.__setattr__(
-                self,
-                "decoder",
-                dataclasses.replace(self.decoder, in_channels=2 * self.gnn.hidden),
+                self, "decoder", dataclasses.replace(self.decoder, **updates)
             )
 
 
@@ -130,19 +139,32 @@ class DeepInteract(nn.Module):
         feats1, efeats1 = self.encode(graph1, train=train)
         feats2, efeats2 = self.encode(graph2, train=train)
 
-        pm = pair_mask(graph1.node_mask, graph2.node_mask)
-        tensor = interaction_tensor(feats1, feats2)
-        if self.cfg.shard_pair_map:
-            from jax.sharding import PartitionSpec as P
+        l1, l2 = feats1.shape[-2], feats2.shape[-2]
+        if self.cfg.tile_pair_map and (
+            l1 > self.cfg.tile_size or l2 > self.cfg.tile_size
+        ):
+            from deepinteract_tpu.models.tiled import tiled_decode
 
-            from deepinteract_tpu.parallel.mesh import DATA_AXIS, PAIR_AXIS
+            logits = tiled_decode(
+                self.decoder, feats1, feats2,
+                graph1.node_mask, graph2.node_mask,
+                tile=self.cfg.tile_size, train=train,
+            )
+        else:
+            pm = pair_mask(graph1.node_mask, graph2.node_mask)
+            tensor = interaction_tensor(feats1, feats2)
+            if self.cfg.shard_pair_map:
+                from jax.sharding import PartitionSpec as P
 
-            # Leave the batch dim unconstrained (its data-axis sharding flows
-            # from the inputs; pinning it would break batch-1 init traces).
-            spec = P(None, PAIR_AXIS)
-            tensor = jax.lax.with_sharding_constraint(tensor, spec)
-            pm = jax.lax.with_sharding_constraint(pm, spec)
-        logits = self.decoder(tensor, pm, train=train)
+                from deepinteract_tpu.parallel.mesh import DATA_AXIS, PAIR_AXIS
+
+                # Leave the batch dim unconstrained (its data-axis sharding
+                # flows from the inputs; pinning it would break batch-1 init
+                # traces).
+                spec = P(None, PAIR_AXIS)
+                tensor = jax.lax.with_sharding_constraint(tensor, spec)
+                pm = jax.lax.with_sharding_constraint(pm, spec)
+            logits = self.decoder(tensor, pm, train=train)
 
         if return_representations:
             return logits, {

@@ -1,0 +1,92 @@
+"""Blockwise (tiled) pair-map decoding — the long-context tier.
+
+Reference: "subsequencing" (``construct_subsequenced_interact_tensors`` /
+``remove_subsequenced_input_padding`` / ``insert_interact_tensor_logits``,
+deepinteract_utils.py:122-155,184-236,239-308; orchestrated at
+deepinteract_modules.py:1695-1737): chains longer than 256 residues split
+into 256-blocks, the cartesian product of blocks runs through the decoder
+independently, and per-tile logits are scattered back into the full L1 x L2
+map. The reference walks tiles with stateful Python index bookkeeping; here
+the tile grid is a static ``lax.scan`` over tile indices:
+
+* the full interaction tensor is never materialized — each scan step slices
+  [T, C] node-feature blocks, builds one [T, T, 2C] tile, and decodes it, so
+  peak memory is one tile's activations regardless of L1 x L2;
+* decoder parameters are broadcast across the scan (``nn.scan``
+  ``variable_broadcast='params'``), dropout rngs split per tile;
+* semantics match the reference: each tile is decoded as an independent map
+  (instance-norm/SE statistics are per-tile, exactly like the reference's
+  per-tile decoder passes).
+
+This composes with context parallelism: the scan runs the tile *grid*
+sequentially while the mesh's 'pair' axis shards *within* each tile.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+
+def tile_grid(l1: int, l2: int, tile: int) -> tuple:
+    if l1 % tile or l2 % tile:
+        raise ValueError(
+            f"padded chain lengths ({l1}, {l2}) must be multiples of the "
+            f"tile size {tile}; pick buckets accordingly"
+        )
+    return l1 // tile, l2 // tile
+
+
+def tiled_decode(
+    decoder: nn.Module,
+    feats1: jnp.ndarray,
+    feats2: jnp.ndarray,
+    mask1: jnp.ndarray,
+    mask2: jnp.ndarray,
+    tile: int,
+    train: bool = False,
+) -> jnp.ndarray:
+    """Decode the [B, L1, L2] pair map in T x T tiles.
+
+    Args:
+      decoder: bound ``InteractionDecoder`` submodule (params shared with
+        the untiled path).
+      feats1, feats2: [B, L1, C], [B, L2, C] encoded node features.
+      mask1, mask2:   [B, L1], [B, L2] validity masks.
+
+    Returns [B, L1, L2, num_classes] logits (padded region zeroed).
+    """
+    b, l1, c = feats1.shape
+    l2 = feats2.shape[1]
+    n1, n2 = tile_grid(l1, l2, tile)
+
+    def step(dec: nn.Module, carry, idx):
+        ti, tj = idx // n2, idx % n2
+        f1 = lax.dynamic_slice_in_dim(feats1, ti * tile, tile, axis=1)
+        f2 = lax.dynamic_slice_in_dim(feats2, tj * tile, tile, axis=1)
+        m1 = lax.dynamic_slice_in_dim(mask1, ti * tile, tile, axis=1)
+        m2 = lax.dynamic_slice_in_dim(mask2, tj * tile, tile, axis=1)
+        pair = jnp.concatenate(
+            [
+                jnp.broadcast_to(f1[:, :, None, :], (b, tile, tile, c)),
+                jnp.broadcast_to(f2[:, None, :, :], (b, tile, tile, c)),
+            ],
+            axis=-1,
+        )
+        pm = m1[:, :, None] & m2[:, None, :]
+        logits = dec(pair, pm, train=train)
+        return carry, logits
+
+    scan = nn.scan(
+        step,
+        variable_broadcast="params",
+        split_rngs={"params": False, "dropout": True},
+        in_axes=0,
+        out_axes=0,
+    )
+    _, tiles = scan(decoder, None, jnp.arange(n1 * n2))
+    # [n1*n2, B, T, T, K] -> [B, L1, L2, K]
+    k = tiles.shape[-1]
+    tiles = tiles.reshape(n1, n2, b, tile, tile, k)
+    return tiles.transpose(2, 0, 3, 1, 4, 5).reshape(b, l1, l2, k)

@@ -1,3 +1,3 @@
-"""TPU compute primitives: edge attention (jnp reference + Pallas kernel)."""
+"""TPU compute primitives: fused edge attention on the dense [N, K] layout."""
 
 from deepinteract_tpu.ops.attention import edge_attention  # noqa: F401

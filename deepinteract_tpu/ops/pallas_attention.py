@@ -1,0 +1,163 @@
+"""Pallas TPU kernel for the fused edge-attention hot loop (scatter mode).
+
+The reference's hottest path is the DGL edge-softmax pipeline
+(``deepinteract_modules.py:76-96``); :mod:`deepinteract_tpu.ops.attention`
+recasts it as dense algebra with a ``segment_sum`` scatter. This kernel goes
+one step further for TPU: **the scatter itself becomes an MXU matmul**.
+
+Key idea: with the dense ``[N, K]`` edge layout, "sum edge quantities into
+their destination node" is ``onehot(nbr)^T @ X`` where
+``onehot[e, j] = (nbr_flat[e] == j)`` — a [E, N] x [E, HD] contraction the
+systolic array eats, instead of a serial scatter the VPU would crawl
+through. Likewise "gather Q at each edge's destination" is
+``onehot @ Q`` and per-head reductions/broadcasts are matmuls against
+block-diagonal 0/1 matrices, so the entire op — score, gate, clip, exp,
+normalize, aggregate — runs in one kernel launch with everything resident
+in VMEM.
+
+Numerics are bit-compatible with ``edge_attention(..., mode='scatter')``
+(same clip/eps constants); the parity test drives both on the same inputs.
+
+Scope: whole-graph-in-VMEM formulation, used for padded buckets up to
+``MAX_KERNEL_NODES`` nodes (the flagship 64/128 buckets); larger buckets
+fall back to the jnp path automatically. Backward runs through
+``jax.custom_vjp`` delegating to the jnp reference implementation's VJP —
+semantics-identical gradients with zero duplicated math.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepinteract_tpu.ops.attention import CLIP, EPS, edge_attention
+
+# Whole-graph VMEM budget: E = N*K rows of [H*D] floats plus two [E, N]
+# one-hot selectors. N=128, K=20, HD=128 => ~13 MB, inside a v5e core's VMEM.
+MAX_KERNEL_NODES = 128
+
+
+def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
+            *, num_nodes: int, knn: int, num_heads: int, head_dim: int):
+    n, kk, h, d = num_nodes, knn, num_heads, head_dim
+    hd = h * d
+    e = n * kk
+    f32 = jnp.float32
+
+    nbr = nbr_ref[0]          # [E, 1] int32
+    mask = mask_ref[0]        # [E, 1] f32
+    q = q_ref[0]              # [N, HD]
+    k = k_ref[0]
+    v = v_ref[0]
+    pe = pe_ref[0]            # [E, HD]
+
+    node_ids = jax.lax.broadcasted_iota(jnp.int32, (e, n), 1)
+    onehot_dst = (nbr == node_ids).astype(f32)                      # [E, N]
+    src_ids = jax.lax.broadcasted_iota(jnp.int32, (e, 1), 0) // kk
+    onehot_src = (src_ids == node_ids).astype(f32)                  # [E, N]
+
+    # Per-head sum / broadcast as block-diagonal 0/1 matmuls.
+    lane_head = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
+    head_ids = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 1)
+    sum_mat = (lane_head == head_ids).astype(f32)                   # [HD, H]
+
+    dot = functools.partial(jnp.dot, preferred_element_type=f32)
+    q_dst = dot(onehot_dst, q)                                      # [E, HD]
+    k_src = dot(onehot_src, k)
+    v_src = dot(onehot_src, v)
+
+    inv_sqrt_d = 1.0 / (d ** 0.5)
+    scaled = jnp.clip(k_src * q_dst * inv_sqrt_d, -CLIP, CLIP) * pe  # [E, HD]
+    logits = jnp.clip(dot(scaled, sum_mat), -CLIP, CLIP)             # [E, H]
+    w = jnp.exp(logits) * mask                                       # [E, H]
+
+    w_full = dot(w, sum_mat.T)                                       # [E, HD]
+    x = w_full * v_src
+    wv = jax.lax.dot_general(onehot_dst, x, (((0,), (0,)), ((), ())),
+                             preferred_element_type=f32)             # [N, HD]
+    z = jax.lax.dot_general(onehot_dst, w, (((0,), (0,)), ((), ())),
+                            preferred_element_type=f32)              # [N, H]
+    z_full = dot(z, sum_mat.T)                                       # [N, HD]
+
+    h_ref[0] = wv / (z_full + EPS)
+    e_ref[0] = scaled * mask
+
+
+def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
+    b, n, h, d = q.shape
+    kk = nbr_idx.shape[-1]
+    e = n * kk
+    hd = h * d
+
+    kernel = functools.partial(
+        _kernel, num_nodes=n, knn=kk, num_heads=h, head_dim=d
+    )
+    flat = lambda t: t.reshape(b, -1, hd)  # noqa: E731
+    h_out, e_out = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, e, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, e, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, e, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, e, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, e, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        nbr_idx.reshape(b, e, 1).astype(jnp.int32),
+        edge_mask.reshape(b, e, 1).astype(jnp.float32),
+        flat(q).astype(jnp.float32),
+        flat(k).astype(jnp.float32),
+        flat(v).astype(jnp.float32),
+        flat(proj_e).astype(jnp.float32),
+    )
+    return h_out.reshape(b, n, h, d), e_out.reshape(b, n, kk, h, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def edge_attention_pallas(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
+    """Drop-in replacement for ``edge_attention(..., mode='scatter')`` on
+    TPU for buckets with N <= MAX_KERNEL_NODES. Returns (h_out, e_out)."""
+    return _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret)
+
+
+def _fwd(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
+    out = _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret)
+    return out, (q, k, v, proj_e, nbr_idx, edge_mask)
+
+
+def _bwd(interpret, res, grads):
+    q, k, v, proj_e, nbr_idx, edge_mask = res
+    # Gradients via the semantics-identical jnp reference path: XLA already
+    # emits a good backward for the dense formulation, and this guarantees
+    # kernel/readback gradient parity by construction.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, pe_: edge_attention(
+            q_, k_, v_, pe_, nbr_idx, edge_mask, mode="scatter"
+        ),
+        q, k, v, proj_e,
+    )
+    dq, dk, dv, dpe = vjp(grads)
+    return dq, dk, dv, dpe, None, None
+
+
+edge_attention_pallas.defvjp(_fwd, _bwd)
+
+
+def supports(n: int) -> bool:
+    """Whether the whole-graph kernel formulation applies to this bucket."""
+    return n <= MAX_KERNEL_NODES

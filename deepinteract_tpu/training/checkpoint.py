@@ -1,0 +1,111 @@
+"""Orbax checkpointing: best-k tracking + last, restore, fine-tune warm start.
+
+TPU-native replacement for Lightning's ``ModelCheckpoint`` configuration in
+the reference (``lit_model_train.py:139-151``): monitor a chosen metric
+(mode 'min' iff its name contains 'ce', exactly the reference's rule),
+keep the top ``save_top_k`` checkpoints plus always the latest
+(``save_top_k=3, save_last=True``, ``lit_model_train.py:144-151``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+def metric_mode(metric_name: str) -> str:
+    """'min' iff the tracked metric name contains 'ce' (lit_model_train.py:
+    139-143); everything else (prec/recall/auroc...) is maximized."""
+    return "min" if "ce" in metric_name else "max"
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    directory: str
+    metric_to_track: str = "val_ce"
+    save_top_k: int = 3
+    keep_last: bool = True
+
+
+class Checkpointer:
+    """Thin orbax wrapper holding two managers: ``best/`` (top-k by the
+    tracked metric) and ``last/`` (most recent, for resume)."""
+
+    def __init__(self, cfg: CheckpointConfig):
+        self.cfg = cfg
+        mode = metric_mode(cfg.metric_to_track)
+        sign = 1.0 if mode == "max" else -1.0
+
+        def best_fn(metrics):
+            v = metrics.get(cfg.metric_to_track, math.nan)
+            # NaN/missing must rank worst AFTER the sign flip, else a NaN
+            # val_ce would score +inf under best_mode='max'.
+            return sign * v if not math.isnan(v) else -math.inf
+
+        root = os.path.abspath(cfg.directory)
+        self.best = ocp.CheckpointManager(
+            os.path.join(root, "best"),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=cfg.save_top_k, best_fn=best_fn, best_mode="max"
+            ),
+        )
+        self.last = (
+            ocp.CheckpointManager(
+                os.path.join(root, "last"),
+                options=ocp.CheckpointManagerOptions(max_to_keep=1),
+            )
+            if cfg.keep_last
+            else None
+        )
+
+    def save(self, step: int, state: Any, metrics: dict) -> None:
+        clean = {
+            k: float(v)
+            for k, v in metrics.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        self.best.save(step, args=ocp.args.StandardSave(state), metrics=clean)
+        if self.last is not None:
+            self.last.save(step, args=ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        self.best.wait_until_finished()
+        if self.last is not None:
+            self.last.wait_until_finished()
+
+    def best_step(self) -> Optional[int]:
+        return self.best.best_step()
+
+    def latest_step(self) -> Optional[int]:
+        if self.last is not None and self.last.latest_step() is not None:
+            return self.last.latest_step()
+        return self.best.latest_step()
+
+    def restore(
+        self, target: Any, step: Optional[int] = None, which: str = "best",
+        partial: bool = False,
+    ) -> Any:
+        """Restore into the structure of ``target`` (an abstract or concrete
+        state pytree). ``partial=True`` restores only the keys present in
+        ``target`` (e.g. params/batch_stats for fine-tune warm starts whose
+        optimizer structure differs from the saved one)."""
+        mgr = self.best if which == "best" or self.last is None else self.last
+        if step is None:
+            step = mgr.best_step() if mgr is self.best and which == "best" else mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.cfg.directory} ({which})")
+        if partial:
+            return mgr.restore(
+                step, args=ocp.args.PyTreeRestore(target, partial_restore=True)
+            )
+        return mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+    def close(self) -> None:
+        self.wait()
+        self.best.close()
+        if self.last is not None:
+            self.last.close()

@@ -1,0 +1,342 @@
+"""The training loop: epochs, validation, early stopping, checkpoints.
+
+Replaces the reference's PyTorch Lightning ``Trainer`` configuration
+(``lit_model_train.py:139-183``) with a compact functional loop:
+
+* EarlyStopping on the tracked metric, patience 5, min_delta 5e-6, mode
+  'min' iff the name contains 'ce' (``lit_model_train.py:140-143``,
+  ``deepinteract_utils.py:1075,1094-1096``).
+* Orbax checkpoints: top-3 by tracked metric + last (:144-151).
+* Per-epoch validation producing the reference's metric suite with median
+  aggregation (``deepinteract_modules.py:1915-2016``).
+* Fine-tune mode: restore params from a checkpoint and freeze the
+  interaction decoder (``deepinteract_modules.py:1546-1557``).
+* Optional mesh: the same loop drives a GSPMD-sharded step (data-parallel
+  over complexes) — the DDP equivalent, SURVEY.md §2.6.
+
+Data protocol: ``train_data``/``val_data`` are callables ``epoch ->
+iterable[PairedComplex]`` (reshuffle per epoch) or plain sequences. Every
+batch must already be padded/bucketed (see ``data.loader``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from deepinteract_tpu.data.graph import PairedComplex
+from deepinteract_tpu.models.model import DeepInteract
+from deepinteract_tpu.training import metrics as M
+from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig, metric_mode
+from deepinteract_tpu.training.optim import OptimConfig
+from deepinteract_tpu.training.steps import TrainState, create_train_state, eval_step, train_step
+
+DataSource = Union[Sequence[PairedComplex], Callable[[int], Iterable[PairedComplex]]]
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopConfig:
+    num_epochs: int = 50  # reference --num_epochs default (deepinteract_utils.py:1093)
+    metric_to_track: str = "val_ce"  # (deepinteract_utils.py:1094-1096)
+    patience: int = 5  # EarlyStopping patience (lit_model_train.py:140-143)
+    min_delta: float = 5e-6
+    ckpt_dir: Optional[str] = None
+    save_top_k: int = 3
+    seed: int = 42  # pl.seed_everything(42) analog (deepinteract_utils.py:1118-1122)
+    weight_classes: bool = False
+    pos_prob_threshold: float = 0.5
+    log_every: int = 100
+    max_time_seconds: Optional[float] = None  # --max_hours/--max_minutes analog
+
+
+class EarlyStopping:
+    """Reference semantics: stop after ``patience`` consecutive epochs
+    without at least ``min_delta`` improvement."""
+
+    def __init__(self, mode: str, patience: int, min_delta: float):
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = math.inf if mode == "min" else -math.inf
+        self.stale_epochs = 0
+
+    def update(self, value: float) -> bool:
+        """Returns True if training should stop (Lightning: stop once
+        ``wait_count >= patience``)."""
+        if math.isnan(value):
+            self.stale_epochs += 1
+            return self.stale_epochs >= self.patience
+        improved = (
+            value < self.best - self.min_delta
+            if self.mode == "min"
+            else value > self.best + self.min_delta
+        )
+        if improved:
+            self.best = value
+            self.stale_epochs = 0
+        else:
+            self.stale_epochs += 1
+        return self.stale_epochs >= self.patience
+
+
+def _iter_data(data: DataSource, epoch: int) -> Iterable[PairedComplex]:
+    return data(epoch) if callable(data) else data
+
+
+class Trainer:
+    """Drives train/val epochs over jitted steps.
+
+    With ``mesh`` set, steps run GSPMD-sharded (state replicated, batch
+    split over the data axis); otherwise plain ``jax.jit`` on the default
+    device. The jit cache keys on batch shapes, so bucketed loaders reuse
+    a handful of compiled executables.
+    """
+
+    def __init__(
+        self,
+        model: DeepInteract,
+        loop_cfg: LoopConfig = LoopConfig(),
+        optim_cfg: Optional[OptimConfig] = None,
+        mesh=None,
+        log_fn: Callable[[str], None] = print,
+        metric_writer=None,
+    ):
+        self.model = model
+        self.cfg = loop_cfg
+        self.optim_cfg = optim_cfg or OptimConfig()
+        self.mesh = mesh
+        self.log = log_fn
+        self.metric_writer = metric_writer
+        if mesh is not None:
+            from deepinteract_tpu.parallel.train import (
+                make_sharded_eval_step,
+                make_sharded_train_step,
+            )
+
+            self._train_step = make_sharded_train_step(
+                mesh, weight_classes=loop_cfg.weight_classes, donate=False
+            )
+            self._eval_step = make_sharded_eval_step(mesh, weight_classes=loop_cfg.weight_classes)
+        else:
+            self._train_step = jax.jit(
+                lambda s, b: train_step(s, b, weight_classes=loop_cfg.weight_classes)
+            )
+            self._eval_step = jax.jit(
+                lambda s, b: eval_step(s, b, weight_classes=loop_cfg.weight_classes)
+            )
+
+    # -- state construction ------------------------------------------------
+
+    def init_state(
+        self,
+        example: PairedComplex,
+        fine_tune_from: Optional[str] = None,
+    ) -> TrainState:
+        state = create_train_state(
+            self.model,
+            example,
+            seed=self.cfg.seed,
+            optim_cfg=self.optim_cfg,
+            # Fine-tune freezes the interaction decoder (reference
+            # deepinteract_modules.py:1546-1557).
+            frozen_prefixes=("decoder",) if fine_tune_from else (),
+        )
+        if fine_tune_from:
+            ckpt = Checkpointer(CheckpointConfig(directory=fine_tune_from))
+            tree = state_to_tree(state)
+            target = {"params": tree["params"], "batch_stats": tree["batch_stats"]}
+            restored = ckpt.restore(target, which="best", partial=True)
+            ckpt.close()
+            state = state.replace(
+                params=restored["params"], batch_stats=restored["batch_stats"]
+            )
+        if self.mesh is not None:
+            from deepinteract_tpu.parallel.mesh import replicate
+
+            state = replicate(state, self.mesh)
+        return state
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(
+        self,
+        state: TrainState,
+        val_data: DataSource,
+        stage: str = "val",
+        targets: Optional[List[str]] = None,
+        csv_path: Optional[str] = None,
+    ) -> Dict[str, float]:
+        """Eval pass producing the reference metric suite (median over
+        complexes; ``stage`` picks the L convention)."""
+        per_complex: List[Dict[str, float]] = []
+        used_targets: List[str] = []
+        idx = 0
+        for batch in _iter_data(val_data, 0):
+            batch = self._device_batch(batch)
+            out = self._eval_step(state, batch)
+            probs = np.asarray(out["probs"])
+            bsz = probs.shape[0]
+            for b in range(bsz):
+                n1 = int(np.asarray(batch.graph1.num_nodes)[b])
+                n2 = int(np.asarray(batch.graph2.num_nodes)[b])
+                examples = np.asarray(batch.examples)[b]
+                mask = np.asarray(batch.example_mask)[b]
+                pos_probs, labels = M.gather_pair_predictions(probs[b], examples, mask)
+                ce = _complex_ce(np.asarray(out["logits"])[b], examples, mask)
+                per_complex.append(
+                    M.complex_metrics(
+                        pos_probs, labels, n1, n2, stage=stage,
+                        threshold=self.cfg.pos_prob_threshold, ce=ce,
+                    )
+                )
+                used_targets.append(targets[idx] if targets else f"complex_{idx}")
+                idx += 1
+        agg = M.aggregate_median(per_complex)
+        agg = {f"{stage}_{k}" if not k.startswith("med_") else f"med_{stage}_{k[4:]}": v
+               for k, v in agg.items()}
+        if csv_path:
+            M.write_topk_csv(per_complex, used_targets, csv_path)
+        return agg
+
+    # -- training ----------------------------------------------------------
+
+    def fit(
+        self,
+        state: TrainState,
+        train_data: DataSource,
+        val_data: Optional[DataSource] = None,
+        num_epochs: Optional[int] = None,
+        resume: bool = False,
+    ):
+        """Run the epoch loop. Returns (state, history: list of per-epoch
+        metric dicts)."""
+        cfg = self.cfg
+        ckpt = Checkpointer(
+            CheckpointConfig(
+                directory=cfg.ckpt_dir,
+                metric_to_track=cfg.metric_to_track,
+                save_top_k=cfg.save_top_k,
+            )
+        ) if cfg.ckpt_dir else None
+
+        start_epoch = 0
+        if resume and ckpt is not None and ckpt.latest_step() is not None:
+            state = _restore_into(state, ckpt.restore(state_to_tree(state), which="last"))
+            start_epoch = int(ckpt.latest_step())
+            self.log(f"resumed from epoch {start_epoch}")
+
+        stopper = EarlyStopping(
+            metric_mode(cfg.metric_to_track), cfg.patience, cfg.min_delta
+        )
+        history: List[Dict[str, float]] = []
+        epochs = num_epochs if num_epochs is not None else cfg.num_epochs
+        t_start = time.time()
+        stop = False
+
+        for epoch in range(start_epoch, epochs):
+            t_epoch = time.time()
+            train_losses = []
+            for i, batch in enumerate(_iter_data(train_data, epoch)):
+                batch = self._device_batch(batch)
+                state, step_metrics = self._train_step(state, batch)
+                train_losses.append(step_metrics["loss"])
+                if cfg.log_every and (i + 1) % cfg.log_every == 0:
+                    self.log(
+                        f"epoch {epoch} step {i + 1}: "
+                        f"loss={float(step_metrics['loss']):.4f} "
+                        f"grad_norm={float(step_metrics['grad_norm']):.4f}"
+                    )
+            epoch_metrics: Dict[str, float] = {
+                "epoch": epoch,
+                "train_loss": float(np.mean([float(l) for l in train_losses]))
+                if train_losses else float("nan"),
+                "epoch_seconds": time.time() - t_epoch,
+            }
+            if val_data is not None:
+                epoch_metrics.update(self.evaluate(state, val_data, stage="val"))
+            history.append(epoch_metrics)
+            self._write_metrics(epoch, epoch_metrics)
+            self.log(
+                f"epoch {epoch}: train_loss={epoch_metrics['train_loss']:.4f} "
+                + " ".join(
+                    f"{k}={v:.4f}" for k, v in epoch_metrics.items()
+                    if k.startswith(("val_", "med_val_")) and isinstance(v, float)
+                    and not math.isnan(v)
+                )
+            )
+
+            if ckpt is not None:
+                ckpt.save(epoch + 1, state_to_tree(state), epoch_metrics)
+
+            tracked = epoch_metrics.get(cfg.metric_to_track, float("nan"))
+            if val_data is not None and stopper.update(tracked):
+                self.log(
+                    f"early stop at epoch {epoch}: no {cfg.metric_to_track} improvement "
+                    f"in {cfg.patience} epochs (best {stopper.best:.6f})"
+                )
+                stop = True
+            if cfg.max_time_seconds and (time.time() - t_start) > cfg.max_time_seconds:
+                self.log("max_time reached; stopping")
+                stop = True
+            if stop:
+                break
+
+        if ckpt is not None:
+            ckpt.close()
+        return state, history
+
+    # -- internals ---------------------------------------------------------
+
+    def _device_batch(self, batch: PairedComplex) -> PairedComplex:
+        if self.mesh is not None:
+            from deepinteract_tpu.parallel.mesh import shard_batch
+
+            return shard_batch(batch, self.mesh)
+        return batch
+
+    def _write_metrics(self, epoch: int, metrics: Dict[str, float]) -> None:
+        if self.metric_writer is None:
+            return
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)) and not math.isnan(float(v)):
+                self.metric_writer.add_scalar(k, float(v), epoch)
+
+
+def _complex_ce(logits: np.ndarray, examples: np.ndarray, mask: np.ndarray) -> float:
+    """Per-complex CE over its example list (the reference's per-step
+    ``self.loss_fn(sampled_logits, labels)``)."""
+    ex = examples[mask]
+    sel = logits[ex[:, 0], ex[:, 1]]  # [M, 2]
+    sel = sel - sel.max(axis=-1, keepdims=True)
+    logp = sel - np.log(np.sum(np.exp(sel), axis=-1, keepdims=True))
+    return float(-np.mean(logp[np.arange(len(ex)), ex[:, 2]]))
+
+
+def state_to_tree(state: TrainState):
+    """Checkpoint payload: the array-valued fields of the TrainState as a
+    plain dict (orbax-friendly; ``apply_fn``/``tx`` are code, not state)."""
+    return jax.tree_util.tree_map(
+        np.asarray,
+        {
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "batch_stats": state.batch_stats,
+            "dropout_rng": state.dropout_rng,
+        },
+    )
+
+
+def _restore_into(state: TrainState, restored) -> TrainState:
+    return state.replace(
+        step=restored["step"],
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        batch_stats=restored["batch_stats"],
+        dropout_rng=restored["dropout_rng"],
+    )

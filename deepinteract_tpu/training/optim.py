@@ -48,7 +48,14 @@ def cosine_warm_restarts(cfg: OptimConfig) -> optax.Schedule:
     return optax.join_schedules(schedules, boundaries)
 
 
-def make_optimizer(cfg: Optional[OptimConfig] = None) -> optax.GradientTransformation:
+def make_optimizer(
+    cfg: Optional[OptimConfig] = None,
+    frozen_prefixes: tuple = (),
+) -> optax.GradientTransformation:
+    """``frozen_prefixes`` names top-level param subtrees whose updates are
+    zeroed — the fine-tune mode that loads a checkpoint and freezes the
+    interaction module (reference ``deepinteract_modules.py:1546-1557``);
+    pass ``("decoder",)`` for reference behavior."""
     cfg = cfg or OptimConfig()
     tx = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm),
@@ -60,6 +67,19 @@ def make_optimizer(cfg: Optional[OptimConfig] = None) -> optax.GradientTransform
             weight_decay=cfg.weight_decay,
         ),
     )
+    if frozen_prefixes:
+        frozen = tuple(frozen_prefixes)
+
+        def labels(params):
+            import jax
+
+            def label_subtree(prefix, subtree):
+                tag = "frozen" if prefix in frozen else "train"
+                return jax.tree_util.tree_map(lambda _: tag, subtree)
+
+            return {k: label_subtree(k, v) for k, v in params.items()}
+
+        tx = optax.multi_transform({"train": tx, "frozen": optax.set_to_zero()}, labels)
     if cfg.accumulate_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=cfg.accumulate_steps)
     return tx

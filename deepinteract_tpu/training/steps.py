@@ -33,9 +33,11 @@ def create_train_state(
     example: PairedComplex,
     seed: int = 42,
     optim_cfg: Optional[OptimConfig] = None,
+    frozen_prefixes: tuple = (),
 ) -> TrainState:
     """Initialize parameters and optimizer state (reference seed 42 default,
-    deepinteract_utils.py:1118-1122)."""
+    deepinteract_utils.py:1118-1122). ``frozen_prefixes`` freezes top-level
+    param subtrees (fine-tune mode, deepinteract_modules.py:1546-1557)."""
     root = jax.random.PRNGKey(seed)
     params_rng, dropout_rng = jax.random.split(root)
     variables = model.init(
@@ -47,7 +49,7 @@ def create_train_state(
     return TrainState.create(
         apply_fn=model.apply,
         params=variables["params"],
-        tx=make_optimizer(optim_cfg),
+        tx=make_optimizer(optim_cfg, frozen_prefixes=frozen_prefixes),
         batch_stats=variables.get("batch_stats", {}),
         dropout_rng=dropout_rng,
     )

@@ -1,0 +1,76 @@
+"""Tests for dataset statistics / split partitioning / leakage tooling."""
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu import constants
+from deepinteract_tpu.data import analysis as A
+from deepinteract_tpu.data.io import save_complex_npz
+
+from tests.test_data_layer import make_raw_complex
+
+
+@pytest.fixture(scope="module")
+def npz_tree(tmp_path_factory):
+    rng = np.random.default_rng(3)
+    root = tmp_path_factory.mktemp("stats")
+    paths = []
+    for i, (n1, n2) in enumerate([(20, 16), (30, 24), (40, 18)]):
+        raw = make_raw_complex(n1, n2, rng)
+        p = str(root / f"c{i}.npz")
+        save_complex_npz(p, raw["graph1"], raw["graph2"], raw["examples"], f"c{i}")
+        paths.append(p)
+    return root, paths
+
+
+def test_statistics(npz_tree, tmp_path):
+    root, paths = npz_tree
+    csv = str(tmp_path / "stats.csv")
+    agg = A.collect_statistics(paths, csv_out=csv)
+    assert agg["num_complexes"] == 3
+    assert agg["num_valid_pairs"] == 3
+    assert agg["median_n1"] == 30
+    header = open(csv).readline()
+    assert "num_pos_contacts" in header and "pos_rate" in header
+
+
+def test_partition_filters_and_splits():
+    items = [(f"c{i}", 100, 100) for i in range(100)]
+    items.append(("too_big", 300, 50))          # residue limit
+    items.append(("too_many_pairs", 256, 256))  # 256^2 pair cap
+    splits = A.partition_filenames(items, seed=0)
+    all_names = splits["train"] + splits["val"] + splits["test"]
+    assert "too_big" not in all_names and "too_many_pairs" not in all_names
+    assert len(all_names) == 100
+    assert len(set(all_names)) == 100
+    assert len(splits["test"]) == 20
+    assert len(splits["val"]) == 20  # 25% of the remaining 80
+
+
+def test_sequence_recovery_and_identity(npz_tree):
+    root, paths = npz_tree
+    from deepinteract_tpu.data.io import load_complex_npz
+
+    raw = load_complex_npz(paths[0])
+    seq = A.sequence_of(raw["graph1"])
+    assert len(seq) == 20
+    assert set(seq) <= set("ACDEFGHIKLMNPQRSTVWYX")
+    assert A.percent_identity(seq, seq) == 1.0
+    assert A.percent_identity("AAAA", "CCCC") == 0.0
+    # LCS semantics: globalxx score of ACGT vs ACT = 3, denom min(4,3)=3.
+    assert A.percent_identity("ACGT", "ACT") == pytest.approx(1.0)
+
+
+def test_leakage_self_detection(npz_tree):
+    root, paths = npz_tree
+    leaks = A.check_leakage(paths[:1], paths[:1], threshold=0.9)
+    assert leaks and leaks[0][2] == 1.0  # identical complex -> 100% identity
+    clean = A.check_leakage(paths[1:2], paths[:1], threshold=0.99)
+    assert clean == []  # random sequences almost surely < 99% identity
+
+
+def test_length_audit(npz_tree):
+    root, paths = npz_tree
+    audit = A.length_audit(paths)
+    assert audit["max"] == 40 and audit["min"] == 16
+    assert audit["over_limit_frac"] == 0.0

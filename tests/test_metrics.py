@@ -1,0 +1,82 @@
+"""Metric-suite tests: hand-computed top-k values + sklearn cross-checks."""
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.training import metrics as M
+
+
+def test_topk_prec_recall_hand_values():
+    # probs descending at indices [3, 1, 0, 2]; labels: 1 at 3 and 0.
+    probs = np.array([0.5, 0.7, 0.1, 0.9])
+    labels = np.array([1, 0, 0, 1])
+    order = np.argsort(-probs, kind="stable")
+    assert M.top_k_prec(order, labels, 1) == 1.0      # top-1 = idx 3 (pos)
+    assert M.top_k_prec(order, labels, 2) == 0.5      # idx 3, 1
+    assert M.top_k_prec(order, labels, 3) == pytest.approx(2 / 3)
+    assert M.top_k_recall(order, labels, 1) == 0.5    # 1 of 2 positives
+    assert M.top_k_recall(order, labels, 4) == 1.0
+
+
+def test_topk_recall_no_positives_is_nan():
+    order = np.array([0, 1])
+    assert np.isnan(M.top_k_recall(order, np.array([0, 0]), 2))
+
+
+def test_l_convention_val_vs_test():
+    """L = n1+n2 in val, min(n1, n2) in test (deepinteract_modules.py:1946
+    vs :2045) — different k grids, hence different values."""
+    rng = np.random.default_rng(0)
+    probs = rng.random(40 * 30)
+    labels = (rng.random(40 * 30) < 0.1).astype(np.int64)
+    val = M.complex_metrics(probs, labels, 40, 30, stage="val")
+    test = M.complex_metrics(probs, labels, 40, 30, stage="test")
+    # val L=70 -> k=7 for L//10; test L=30 -> k=3.
+    order = np.argsort(-probs, kind="stable")
+    assert val["top_l_by_10_prec"] == M.top_k_prec(order, labels, 7)
+    assert test["top_l_by_10_prec"] == M.top_k_prec(order, labels, 3)
+
+
+def test_binary_suite_matches_sklearn():
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    rng = np.random.default_rng(1)
+    probs = rng.random(500)
+    labels = (rng.random(500) < 0.2).astype(np.int64)
+    out = M.binary_suite(probs, labels)
+    assert out["auroc"] == pytest.approx(roc_auc_score(labels, probs), abs=1e-9)
+    assert out["auprc"] == pytest.approx(average_precision_score(labels, probs), abs=1e-9)
+
+    pred = probs >= 0.5
+    tp = np.sum(pred & (labels == 1))
+    assert out["prec"] == pytest.approx(tp / pred.sum())
+    assert out["recall"] == pytest.approx(tp / labels.sum())
+    assert out["acc"] == out["recall"]  # torchmetrics per-class accuracy quirk
+
+
+def test_aggregate_median_skips_nan():
+    agg = M.aggregate_median(
+        [{"auroc": 0.5, "ce": 1.0}, {"auroc": float("nan"), "ce": 3.0}, {"auroc": 0.9, "ce": 2.0}]
+    )
+    assert agg["med_auroc"] == pytest.approx(0.7)
+    assert agg["ce"] == pytest.approx(2.0)
+
+
+def test_csv_export_columns(tmp_path):
+    per = [M.complex_metrics(np.array([0.9, 0.1]), np.array([1, 0]), 1, 2, stage="test")]
+    path = tmp_path / "out.csv"
+    M.write_topk_csv(per, ["4heq"], str(path))
+    header = path.read_text().splitlines()[0]
+    assert header == ",top_10_prec,top_l_by_10_prec,top_l_by_5_prec,top_l_recall,top_l_by_2_recall,top_l_by_5_recall,target"
+    assert "4heq" in path.read_text()
+
+
+def test_gather_pair_predictions():
+    probs = np.zeros((3, 4, 2))
+    probs[1, 2, 1] = 0.8
+    probs[0, 0, 1] = 0.3
+    examples = np.array([[1, 2, 1], [0, 0, 0], [0, 0, 0]])
+    mask = np.array([True, True, False])
+    p, y = M.gather_pair_predictions(probs, examples, mask)
+    np.testing.assert_allclose(p, [0.8, 0.3])
+    np.testing.assert_array_equal(y, [1, 0])

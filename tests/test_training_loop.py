@@ -1,0 +1,114 @@
+"""Training-loop tests: epochs/metrics, checkpoints, early stop, resume,
+fine-tune freeze. Tiny config to keep XLA compile time bounded."""
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.data.synthetic import random_complex
+from deepinteract_tpu.data.graph import stack_complexes
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+from deepinteract_tpu.training.loop import EarlyStopping, LoopConfig, Trainer
+from deepinteract_tpu.training.optim import OptimConfig
+
+
+def tiny_model():
+    return DeepInteract(
+        ModelConfig(
+            gnn=GTConfig(num_layers=2, hidden=16, num_heads=2, shared_embed=8,
+                         dropout_rate=0.0),
+            decoder=DecoderConfig(num_chunks=1, num_channels=8, dilation_cycle=(1,)),
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    batches = [
+        stack_complexes([random_complex(20, 16, rng=rng, n_pad1=24, n_pad2=24, knn=6,
+                                        geo_nbrhd_size=2)])
+        for _ in range(3)
+    ]
+    return batches
+
+
+@pytest.fixture(scope="module")
+def optim_cfg():
+    return OptimConfig(steps_per_epoch=3, num_epochs=4)
+
+
+def test_early_stopping_semantics():
+    es = EarlyStopping(mode="min", patience=2, min_delta=0.0)
+    assert not es.update(1.0)
+    assert not es.update(0.9)   # improved
+    assert not es.update(0.95)  # stale 1
+    assert es.update(0.93)      # stale 2 -> stop
+    es2 = EarlyStopping(mode="max", patience=1, min_delta=0.5)
+    assert not es2.update(1.0)
+    assert es2.update(1.2)  # below min_delta -> stale -> stop
+
+
+def test_fit_trains_checkpoints_and_evaluates(tmp_path, data, optim_cfg):
+    model = tiny_model()
+    cfg = LoopConfig(num_epochs=2, ckpt_dir=str(tmp_path / "ckpt"), log_every=0,
+                     patience=5)
+    trainer = Trainer(model, cfg, optim_cfg, log_fn=lambda s: None)
+    state = trainer.init_state(data[0])
+    state, history = trainer.fit(state, data, val_data=data[:1])
+
+    assert len(history) == 2
+    assert np.isfinite(history[0]["train_loss"])
+    assert "val_ce" in history[0] and np.isfinite(history[0]["val_ce"])
+    assert "med_val_top_10_prec" in history[0]
+    assert int(state.step) == 2 * len(data)
+    # Checkpoints on disk: best/ and last/ populated.
+    assert (tmp_path / "ckpt" / "best").exists()
+    assert (tmp_path / "ckpt" / "last").exists()
+
+    # Resume: a fresh trainer restores epoch count and continues.
+    trainer2 = Trainer(model, cfg, optim_cfg, log_fn=lambda s: None)
+    state2 = trainer2.init_state(data[0])
+    state2, history2 = trainer2.fit(state2, data, val_data=data[:1],
+                                    num_epochs=3, resume=True)
+    assert len(history2) == 1  # only epoch 2 ran
+    assert history2[0]["epoch"] == 2
+    assert int(state2.step) == 3 * len(data)
+
+
+def test_early_stop_fires(tmp_path, data, optim_cfg):
+    model = tiny_model()
+    # min_delta so large nothing ever counts as improvement.
+    cfg = LoopConfig(num_epochs=10, ckpt_dir=None, patience=2, min_delta=1e9,
+                     log_every=0)
+    trainer = Trainer(model, cfg, optim_cfg, log_fn=lambda s: None)
+    state = trainer.init_state(data[0])
+    state, history = trainer.fit(state, data, val_data=data[:1])
+    # Epoch 0 sets `best`; epochs 1-2 are stale -> stop after 3 total.
+    assert len(history) == 3
+
+
+def test_fine_tune_freezes_decoder(tmp_path, data, optim_cfg):
+    import jax
+
+    model = tiny_model()
+    cfg = LoopConfig(num_epochs=1, ckpt_dir=str(tmp_path / "pre"), log_every=0)
+    trainer = Trainer(model, cfg, optim_cfg, log_fn=lambda s: None)
+    state = trainer.init_state(data[0])
+    state, _ = trainer.fit(state, data, val_data=data[:1])
+
+    ft = trainer.init_state(data[0], fine_tune_from=str(tmp_path / "pre"))
+    # Warm start restored the trained params.
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(ft.params)[0]),
+        np.asarray(jax.tree_util.tree_leaves(state.params)[0]),
+    )
+    before = jax.tree_util.tree_map(np.asarray, ft.params["decoder"])
+    gnn_before = np.asarray(jax.tree_util.tree_leaves(ft.params["gnn"])[0])
+    ft2, _ = trainer.fit(ft, data)  # no val; runs 1 epoch
+    after = jax.tree_util.tree_map(np.asarray, ft2.params["decoder"])
+    for a, b in zip(jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(a, b)  # decoder frozen
+    gnn_after = np.asarray(jax.tree_util.tree_leaves(ft2.params["gnn"])[0])
+    assert not np.array_equal(gnn_before, gnn_after)  # encoder trains
