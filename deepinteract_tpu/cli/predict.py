@@ -1,7 +1,9 @@
 """Predict CLI — reference ``project/lit_model_predict.py`` equivalent.
 
-Takes one complex (an ``.npz`` in our format — produced by the converter or
-the featurization pipeline), restores a checkpoint, and writes:
+Takes one complex — either an ``.npz`` in our format (converter output) or
+a raw PDB pair via ``--left_pdb``/``--right_pdb`` (featurized on the fly by
+:mod:`deepinteract_tpu.pipeline`, the reference's ``InputDataset`` flow at
+lit_model_predict.py:22-143) — restores a checkpoint, and writes:
 
 * ``contact_prob_map.npy``      — [n1, n2] positive-class softmax map
 * ``graph1_node_feats.npy`` / ``graph2_node_feats.npy``
@@ -24,10 +26,17 @@ from deepinteract_tpu.cli.args import build_parser, configs_from_args
 
 def main(argv=None) -> int:
     parser = build_parser(__doc__)
-    parser.add_argument("--input_npz", type=str, required=True,
+    parser.add_argument("--input_npz", type=str, default=None,
                         help="complex .npz (see deepinteract_tpu.data.io)")
+    parser.add_argument("--left_pdb", type=str, default=None,
+                        help="left chain PDB (featurized by the pipeline)")
+    parser.add_argument("--right_pdb", type=str, default=None)
+    parser.add_argument("--save_npz", type=str, default=None,
+                        help="also persist the featurized complex here")
     parser.add_argument("--output_dir", type=str, default=".")
     args = parser.parse_args(argv)
+    if not args.input_npz and not (args.left_pdb and args.right_pdb):
+        parser.error("provide --input_npz or both --left_pdb and --right_pdb")
 
     import jax
 
@@ -39,7 +48,15 @@ def main(argv=None) -> int:
 
     model_cfg, optim_cfg, loop_cfg = configs_from_args(args)
 
-    raw = load_complex_npz(args.input_npz)
+    if args.input_npz:
+        raw = load_complex_npz(args.input_npz)
+    else:
+        from deepinteract_tpu.pipeline.pair import convert_pdb_pair_to_complex
+
+        raw = convert_pdb_pair_to_complex(
+            args.left_pdb, args.right_pdb,
+            output_npz=args.save_npz, with_labels=False,
+        )
     n1 = raw["graph1"]["node_feats"].shape[0]
     n2 = raw["graph2"]["node_feats"].shape[0]
     batch = stack_complexes([to_paired_complex(raw, input_indep=args.input_indep)])

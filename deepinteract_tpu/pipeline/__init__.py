@@ -1,0 +1,20 @@
+"""Raw-data pipeline: PDB files -> 113/28-schema graph pairs.
+
+TPU-framework replacement for the reference's L0/L1 feature toolchain
+(SURVEY.md §2.3). The reference shells out to four native binaries —
+HH-suite3 (sequence profiles), PSAIA (protrusion), DSSP (secondary
+structure + RSA), MSMS (residue depth) — orchestrated by
+``convert_input_pdb_files_to_pair`` (deepinteract_utils.py:794-850).
+
+Here the structural features are computed in-repo: a C++ native library
+(:mod:`deepinteract_tpu.pipeline.native`) provides the O(atoms^2)-class
+geometry kernels (Shrake-Rupley SASA, residue min-distance matrix,
+protrusion index, residue depth) with vectorized numpy fallbacks, and pure
+Python derives DSSP-style secondary structure, HSAAC/CN and PSAIA-style
+protrusion statistics from them. Sequence profiles (the one feature that
+fundamentally needs an external database) fall back to zeros with a
+warning unless an hhblits binary + DB is configured.
+"""
+
+from deepinteract_tpu.pipeline.pdb import parse_pdb_chains, Chain
+from deepinteract_tpu.pipeline.pair import convert_pdb_pair_to_complex

@@ -1,0 +1,303 @@
+"""Raw-data pipeline tests: parser, native-vs-numpy parity, DSSP sanity,
+schema assembly, and the 4heq end-to-end smoke path (SURVEY.md §2.3)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu import constants
+from deepinteract_tpu.pipeline import native
+from deepinteract_tpu.pipeline import residue_features as rf
+from deepinteract_tpu.pipeline.pair import (
+    build_examples,
+    convert_pdb_pair_to_complex,
+    interface_labels,
+)
+from deepinteract_tpu.pipeline.pdb import parse_pdb_chains
+from deepinteract_tpu.pipeline.postprocess import (
+    compute_residue_features,
+    impute_columns,
+    min_max_normalize_columns,
+)
+
+REF_TEST_DATA = "/root/reference/project/test_data"
+HAVE_4HEQ = os.path.exists(os.path.join(REF_TEST_DATA, "4heq_l_u.pdb"))
+
+
+def _write_helix_pdb(path, n_res=12, chain="A"):
+    """Synthetic ideal alpha-helix poly-alanine PDB (right-handed, 100
+    degrees/residue, 1.5 A rise) with exact backbone geometry."""
+    lines = []
+    serial = 1
+    # Backbone atom placements relative to helix axis (approx. ideal).
+    atom_r = {"N": 1.56, "CA": 2.28, "C": 1.68, "O": 2.00, "CB": 3.30}
+    atom_dphi = {"N": -0.48, "CA": 0.0, "C": 0.50, "O": 0.70, "CB": -0.2}
+    atom_dz = {"N": -0.60, "CA": 0.0, "C": 0.65, "O": 1.80, "CB": -0.5}
+    for i in range(n_res):
+        phi0 = np.radians(100.0) * i
+        z0 = 1.5 * i
+        for name in ("N", "CA", "C", "O", "CB"):
+            phi = phi0 + atom_dphi[name]
+            x = atom_r[name] * np.cos(phi)
+            y = atom_r[name] * np.sin(phi)
+            z = z0 + atom_dz[name]
+            el = name[0]
+            lines.append(
+                f"ATOM  {serial:5d} {name:<4s} ALA {chain}{i + 1:4d}    "
+                f"{x:8.3f}{y:8.3f}{z:8.3f}  1.00  0.00          {el:>2s}"
+            )
+            serial += 1
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\nEND\n")
+    return path
+
+
+@pytest.fixture(scope="module")
+def helix_pdb(tmp_path_factory):
+    return _write_helix_pdb(str(tmp_path_factory.mktemp("pdb") / "helix.pdb"))
+
+
+@pytest.fixture(scope="module")
+def helix_chain(helix_pdb):
+    return parse_pdb_chains(helix_pdb)["A"]
+
+
+class TestParser:
+    def test_parse_chain(self, helix_chain):
+        assert len(helix_chain) == 12
+        assert helix_chain.num_atoms == 12 * 5
+        assert helix_chain.resnames[0] == "ALA"
+        assert helix_chain.sequence() == "A" * 12
+
+    def test_backbone_and_cb(self, helix_chain):
+        bb = helix_chain.backbone()
+        assert bb.shape == (12, 4, 3)
+        assert np.isfinite(bb).all()
+        cb = helix_chain.cb_coords()
+        assert np.isfinite(cb).all()  # all ALA have CB
+
+    def test_hydrogens_and_het_skipped(self, tmp_path):
+        path = str(tmp_path / "mixed.pdb")
+        with open(path, "w") as f:
+            f.write(
+                "ATOM      1  N   GLY A   1       0.000   0.000   0.000  1.00  0.00           N\n"
+                "ATOM      2  CA  GLY A   1       1.450   0.000   0.000  1.00  0.00           C\n"
+                "ATOM      3  H   GLY A   1       0.500   0.900   0.000  1.00  0.00           H\n"
+                "HETATM    4  O   HOH A 101       5.000   5.000   5.000  1.00  0.00           O\n"
+            )
+        ch = parse_pdb_chains(path)["A"]
+        assert ch.num_atoms == 2  # H and HOH dropped
+
+    def test_legacy_hydrogen_names_and_b_only_altloc(self, tmp_path):
+        path = str(tmp_path / "legacy.pdb")
+        with open(path, "w") as f:
+            # No element columns: '1HB ' must be recognized as hydrogen.
+            # Residue 2's only conformer is altloc 'B' and must be kept.
+            f.write(
+                "ATOM      1  N   ALA A   1       0.000   0.000   0.000\n"
+                "ATOM      2  CA  ALA A   1       1.450   0.000   0.000\n"
+                "ATOM      3 1HB  ALA A   1       2.000   1.000   0.000\n"
+                "ATOM      4  CA BALA A   2       4.800   0.000   0.000  1.00  0.00           C\n"
+            )
+        ch = parse_pdb_chains(path)["A"]
+        assert len(ch) == 2  # altloc-B residue retained
+        assert "1HB" not in ch.atom_names  # legacy hydrogen dropped
+        assert ch.num_atoms == 3
+
+    def test_residue_without_ca_skipped(self, tmp_path):
+        path = str(tmp_path / "noca.pdb")
+        with open(path, "w") as f:
+            f.write(
+                "ATOM      1  N   GLY A   1       0.000   0.000   0.000  1.00  0.00           N\n"
+                "ATOM      2  CA  ALA A   2       3.800   0.000   0.000  1.00  0.00           C\n"
+            )
+        ch = parse_pdb_chains(path)["A"]
+        assert len(ch) == 1 and ch.resnames == ["ALA"]
+
+
+needs_native = pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+
+
+@needs_native
+class TestNativeParity:
+    """C++ kernels vs the vectorized numpy fallbacks on the same inputs."""
+
+    def test_sasa_and_depth(self, helix_chain):
+        radii = rf.atom_radii(helix_chain.elements)
+        s_n, d_n = native.sasa_and_depth(helix_chain.coords, radii, rf.N_SPHERE,
+                                         rf.PROBE_RADIUS)
+        s_p, d_p = rf._sasa_and_depth_numpy(helix_chain.coords, radii)
+        np.testing.assert_allclose(s_n, s_p, rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(d_n, d_p, rtol=1e-4, atol=1e-3)
+
+    def test_min_dist_matrix(self, helix_chain):
+        m_n = native.min_dist_matrix(helix_chain.coords, helix_chain.atom_start)
+        m_p = rf._min_dist_matrix_numpy(helix_chain.coords, helix_chain.atom_start)
+        np.testing.assert_allclose(m_n, m_p, rtol=1e-4, atol=1e-3)
+
+    def test_cross_min_dist(self, helix_chain):
+        m = native.cross_min_dist_matrix(
+            helix_chain.coords, helix_chain.atom_start,
+            helix_chain.coords, helix_chain.atom_start,
+        )
+        m_self = rf._min_dist_matrix_numpy(helix_chain.coords, helix_chain.atom_start)
+        np.testing.assert_allclose(m, m_self, rtol=1e-4, atol=1e-3)
+
+    def test_protrusion(self, helix_chain):
+        c_n = native.protrusion_cx(helix_chain.coords, rf.CX_SPHERE_RADIUS,
+                                   rf.CX_ATOM_VOLUME)
+        c_p = rf._protrusion_cx_numpy(helix_chain.coords)
+        np.testing.assert_allclose(c_n, c_p, rtol=1e-4, atol=1e-3)
+
+
+class TestResidueFeatures:
+    def test_helix_assigned_h(self, helix_chain):
+        ss = rf.assign_secondary_structure(helix_chain.backbone(),
+                                           helix_chain.resnames)
+        # Interior of an ideal alpha helix must be H; termini may differ.
+        assert all(s == "H" for s in ss[2:-3]), ss
+
+    def test_extended_strand_not_h(self):
+        # A straight extended chain: no i->i+4 H-bonds, so no helix.
+        n = 10
+        bb = np.zeros((n, 4, 3), dtype=np.float32)
+        for i in range(n):
+            bb[i, 0] = [3.5 * i - 1.2, 0.3, 0.0]
+            bb[i, 1] = [3.5 * i, 0.0, 0.0]
+            bb[i, 2] = [3.5 * i + 1.2, -0.3, 0.0]
+            bb[i, 3] = [3.5 * i + 1.2, -1.5, 0.0]
+        ss = rf.assign_secondary_structure(bb)
+        assert "H" not in ss
+
+    def test_ss_one_hot_unknown_maps_to_dash(self):
+        oh = rf.ss_one_hot(["H", "X"])
+        assert oh[0, 0] == 1.0 and oh[1, -1] == 1.0
+
+    def test_resname_one_hot_unknown_maps_to_last(self):
+        oh = rf.resname_one_hot(["TRP", "UNK"])
+        assert oh[0, 0] == 1.0 and oh[1, -1] == 1.0
+        assert oh.sum() == 2.0
+
+    def test_rsa_range_and_exposure(self, helix_chain):
+        sasa, depth = rf.sasa_and_depth(helix_chain.coords,
+                                        rf.atom_radii(helix_chain.elements))
+        rsa = rf.relative_solvent_accessibility(helix_chain, sasa)
+        assert ((0.0 <= rsa) & (rsa <= 1.0)).all()
+        assert rsa.mean() > 0.2  # a lone helix is mostly exposed
+        rd = rf.residue_depth(helix_chain, depth)
+        assert (rd >= 0).all()
+
+    def test_similarity_and_hsaac(self, helix_chain):
+        md = rf.min_dist_matrix(helix_chain)
+        close, cn = rf.similarity_matrix(md)
+        assert close.diagonal().all()  # self always close
+        assert (cn >= 1).all()
+        h = rf.hsaac(helix_chain, close)
+        assert h.shape == (12, constants.HSAAC_DIM)
+        assert np.isfinite(h).all()
+        # poly-ALA: only the A column (index 0) and none of the others
+        a_idx = constants.AMINO_ACIDS.index("A")
+        other = np.delete(h, [a_idx, 21 + a_idx], axis=1)
+        assert np.abs(other).max() == 0.0
+
+    def test_side_chain_vectors_gly(self, tmp_path):
+        path = str(tmp_path / "gly.pdb")
+        with open(path, "w") as f:
+            f.write(
+                "ATOM      1  N   GLY A   1       0.000   1.400   0.000  1.00  0.00           N\n"
+                "ATOM      2  CA  GLY A   1       0.000   0.000   0.000  1.00  0.00           C\n"
+                "ATOM      3  C   GLY A   1       1.400   0.000   0.000  1.00  0.00           C\n"
+            )
+        ch = parse_pdb_chains(path)["A"]
+        v = rf.side_chain_vectors(ch)
+        # gly vector = -mean(unit(C-CA), unit(N-CA)) = -(x_hat + y_hat)/2
+        np.testing.assert_allclose(v[0], [-0.5, -0.5, 0.0], atol=1e-5)
+
+
+class TestPostprocess:
+    def test_min_max_normalize_nan_transparent(self):
+        x = np.array([[1.0, np.nan], [3.0, 2.0], [2.0, 4.0]])
+        out = min_max_normalize_columns(x)
+        np.testing.assert_allclose(out[:, 0], [0.0, 1.0, 0.5])
+        assert np.isnan(out[0, 1]) and out[1, 1] == 0.0 and out[2, 1] == 1.0
+
+    def test_impute_median_vs_zero(self):
+        col_few = np.array([1.0, np.nan, 3.0, 5.0, np.nan, 7.0, 9.0, 11.0])
+        col_many = np.array([1.0] + [np.nan] * 7)
+        x = np.stack([col_few, col_many], axis=1)
+        out = impute_columns(x)
+        assert out[1, 0] == 6.0  # median of {1,3,5,7,9,11}
+        assert (out[1:, 1] == 0.0).all()  # >5 NaNs -> zero fill
+
+    def test_residue_features_schema(self, helix_chain):
+        feats = compute_residue_features(helix_chain)
+        assert feats.shape == (12, constants.NUM_NODE_FEATS - 7)
+        assert np.isfinite(feats).all()
+        # resname one-hot occupies the ALA slot.
+        ala = constants.ALLOWABLE_RESNAMES.index("ALA")
+        assert (feats[:, ala] == 1.0).all()
+        # sequence feats (no hhblits here) are zeros.
+        seq = feats[:, constants.NODE_SEQUENCE_FEATS.start - 7:]
+        assert np.abs(seq).max() == 0.0
+
+
+class TestPairAssembly:
+    def test_interface_labels_and_examples(self, helix_chain):
+        labels = interface_labels(helix_chain, helix_chain)
+        assert labels.diagonal().all()  # self-pair: distance 0 < 6A
+        ex = build_examples(labels)
+        assert ex.shape == (144, 3)
+        assert ex[:, 2].sum() == labels.sum()
+
+    @pytest.mark.skipif(not HAVE_4HEQ, reason="reference test_data not mounted")
+    def test_4heq_end_to_end(self, tmp_path):
+        out = str(tmp_path / "4heq.npz")
+        raw = convert_pdb_pair_to_complex(
+            os.path.join(REF_TEST_DATA, "4heq_l_u.pdb"),
+            os.path.join(REF_TEST_DATA, "4heq_r_u.pdb"),
+            output_npz=out,
+        )
+        g1, g2 = raw["graph1"], raw["graph2"]
+        assert g1["node_feats"].shape[1] == constants.NUM_NODE_FEATS
+        assert g1["edge_feats"].shape[1:] == (constants.KNN, constants.NUM_EDGE_FEATS)
+        for g in (g1, g2):
+            for k, v in g.items():
+                assert np.isfinite(v).all(), k
+        assert raw["examples"][:, 2].sum() > 0  # 4heq chains do interface
+
+        # Round-trips through the npz format and the padded model input.
+        from deepinteract_tpu.data.io import load_complex_npz, to_paired_complex
+
+        loaded = load_complex_npz(out)
+        pc = to_paired_complex(loaded)
+        n1 = g1["node_feats"].shape[0]
+        assert int(pc.graph1.num_nodes) == n1
+        assert pc.graph1.node_feats.shape[0] >= n1
+
+
+class TestPredictFromPDB:
+    def test_predict_cli_pdb_path(self, tmp_path):
+        """Raw PDB pair -> predict CLI -> contact map artifacts (the
+        reference's lit_model_predict.py user surface)."""
+        from deepinteract_tpu.cli import predict as predict_cli
+
+        left = _write_helix_pdb(str(tmp_path / "l.pdb"), n_res=24)
+        right = _write_helix_pdb(str(tmp_path / "r.pdb"), n_res=22)
+        out_dir = str(tmp_path / "out")
+        rc = predict_cli.main([
+            "--left_pdb", left, "--right_pdb", right,
+            "--save_npz", str(tmp_path / "c.npz"),
+            "--output_dir", out_dir,
+            "--num_gnn_layers", "1",
+            "--num_gnn_hidden_channels", "8",
+            "--num_gnn_attention_heads", "2",
+            "--num_interact_layers", "1",
+            "--num_interact_hidden_channels", "8",
+            "--dropout_rate", "0.0",
+        ])
+        assert rc == 0
+        probs = np.load(os.path.join(out_dir, "contact_prob_map.npy"))
+        assert probs.shape == (24, 22)
+        assert np.isfinite(probs).all() and (0 <= probs).all() and (probs <= 1).all()
+        assert os.path.exists(str(tmp_path / "c.npz"))
