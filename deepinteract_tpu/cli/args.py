@@ -46,6 +46,11 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--num_gnn_layers", type=int, default=2)
     g.add_argument("--num_gnn_hidden_channels", type=int, default=128)
     g.add_argument("--num_gnn_attention_heads", type=int, default=4)
+    g.add_argument("--interact_module_type", choices=("dilated", "deeplab"),
+                   default="dilated",
+                   help="dilated = SE-ResNet decoder (reference default); "
+                        "deeplab = DeepLabV3+ alternative "
+                        "(deepinteract_modules.py:1626-1650)")
     g.add_argument("--num_interact_layers", type=int, default=14,
                    help="decoder ResNet chunks")
     g.add_argument("--num_interact_hidden_channels", type=int, default=128)
@@ -79,6 +84,13 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
                    help="warm-start from --ckpt_name and freeze the decoder "
                         "(deepinteract_modules.py:1546-1557)")
     g.add_argument("--resume", action="store_true")
+    g.add_argument("--stochastic_weight_avg", action="store_true",
+                   help="average params over the last 20%% of epochs "
+                        "(lit_model_train.py:157-159)")
+    g.add_argument("--viz_every_n_epochs", type=int, default=0,
+                   help="log predicted/true contact-map images to "
+                        "TensorBoard every N epochs (0 = off; reference viz "
+                        "branch, deepinteract_modules.py:1808-1884)")
     g.add_argument("--weight_classes", action="store_true",
                    help="1:5 positive class weighting "
                         "(deepinteract_modules.py:1781-1787)")
@@ -129,10 +141,14 @@ def configs_from_args(
         use_attention=args.use_interact_attention,
         dropout_rate=args.dropout_rate,
     )
+    from deepinteract_tpu.models.vision import DeepLabConfig
+
     model_cfg = ModelConfig(
         gnn=gnn,
         decoder=decoder,
+        deeplab=DeepLabConfig(dropout_rate=args.dropout_rate),
         gnn_layer_type=args.gnn_layer_type,
+        interact_module_type=args.interact_module_type,
         shard_pair_map=args.shard_pair_map or args.num_pair_shards > 1,
         tile_pair_map=args.tile_pair_map,
     )
@@ -154,6 +170,8 @@ def configs_from_args(
         pos_prob_threshold=args.pos_prob_threshold,
         log_every=args.log_every,
         max_time_seconds=args.max_hours * 3600 if args.max_hours else None,
+        swa=args.stochastic_weight_avg,
+        viz_every_n_epochs=args.viz_every_n_epochs,
     )
     return model_cfg, optim_cfg, loop_cfg
 

@@ -22,6 +22,7 @@ from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
 from deepinteract_tpu.models.geometric_transformer import GeometricTransformer, GTConfig
 from deepinteract_tpu.models.interaction import interaction_tensor, pair_mask
 from deepinteract_tpu.models.layers import GODense
+from deepinteract_tpu.models.vision import DeepLabConfig, DeepLabDecoder
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,6 +34,10 @@ class ModelConfig:
     gnn: GTConfig = dataclasses.field(default_factory=GTConfig)
     decoder: DecoderConfig = dataclasses.field(default_factory=DecoderConfig)
     gnn_layer_type: str = "geotran"  # 'geotran' | 'gcn'
+    # 'dilated' = SE-ResNet decoder (reference default); 'deeplab' = the
+    # DeepLabV3+ alternative (LitGINI.build_interaction_module routing,
+    # deepinteract_modules.py:1626-1650).
+    interact_module_type: str = "dilated"
     num_classes: int = C.NUM_CLASSES
     # Context parallelism: annotate the L1 x L2 interaction map for sharding
     # over the mesh's 'pair' axis (requires an active mesh context). This is
@@ -45,6 +50,7 @@ class ModelConfig:
     # models/tiled.py). Engages only when the padded map exceeds one tile.
     tile_pair_map: bool = False
     tile_size: int = C.PAIR_MAP_TILE
+    deeplab: DeepLabConfig = dataclasses.field(default_factory=DeepLabConfig)
 
     def __post_init__(self):
         updates = {}
@@ -55,6 +61,17 @@ class ModelConfig:
         if updates:
             object.__setattr__(
                 self, "decoder", dataclasses.replace(self.decoder, **updates)
+            )
+        if self.deeplab.in_channels != 2 * self.gnn.hidden or (
+            self.deeplab.num_classes != self.num_classes
+        ):
+            object.__setattr__(
+                self, "deeplab",
+                dataclasses.replace(
+                    self.deeplab,
+                    in_channels=2 * self.gnn.hidden,
+                    num_classes=self.num_classes,
+                ),
             )
 
 
@@ -119,7 +136,10 @@ class DeepInteract(nn.Module):
             self.gnn = GCNStack(gnn_cfg, num_layers=gnn_cfg.num_layers)
         else:
             self.gnn = GeometricTransformer(gnn_cfg)
-        self.decoder = InteractionDecoder(self.cfg.decoder)
+        if self.cfg.interact_module_type == "deeplab":
+            self.decoder = DeepLabDecoder(self.cfg.deeplab)
+        else:
+            self.decoder = InteractionDecoder(self.cfg.decoder)
 
     def encode(self, graph: ProteinGraph, train: bool = False):
         """Shared-weight chain encoder (siamese leg)."""

@@ -99,6 +99,32 @@ def featurize_structure(
     )
 
 
+def convert_bound_complex_to_pair(
+    pdb_path: str,
+    chain1: str,
+    chain2: str,
+    output_npz: Optional[str] = None,
+    **kwargs,
+) -> Dict:
+    """One bound-complex PDB + two chain ids -> labeled complex.
+
+    The single-file analog of the DIPS builder flow (atom3 ``make_dataset``
+    parses bound RCSB complexes into chain pairs pruned at the 6 A
+    interface criterion, deepinteract_utils.py:611-628). Accepts the same
+    keyword arguments as :func:`convert_pdb_pair_to_complex`.
+    """
+    chains = parse_pdb_chains(pdb_path)
+    for cid in (chain1, chain2):
+        if cid not in chains:
+            raise ValueError(
+                f"chain {cid!r} not found in {pdb_path}; has {sorted(chains)}"
+            )
+    return _convert_structures(
+        chains[chain1], chains[chain2], output_npz=output_npz,
+        complex_name=f"{pdb_path}:{chain1}-{chain2}", **kwargs,
+    )
+
+
 def convert_pdb_pair_to_complex(
     left_pdb: str,
     right_pdb: str,
@@ -115,8 +141,30 @@ def convert_pdb_pair_to_complex(
     The returned dict matches ``data.io.load_complex_npz`` output, so it
     feeds directly into ``to_paired_complex`` -> model.
     """
-    chain1 = load_structure(left_pdb)
-    chain2 = load_structure(right_pdb)
+    return _convert_structures(
+        load_structure(left_pdb),
+        load_structure(right_pdb),
+        output_npz=output_npz,
+        with_labels=with_labels,
+        knn=knn,
+        geo_nbrhd_size=geo_nbrhd_size,
+        use_native=use_native,
+        seed=seed,
+        complex_name=complex_name or f"{left_pdb}:{right_pdb}",
+    )
+
+
+def _convert_structures(
+    chain1: Chain,
+    chain2: Chain,
+    output_npz: Optional[str] = None,
+    with_labels: bool = True,
+    knn: int = constants.KNN,
+    geo_nbrhd_size: int = constants.GEO_NBRHD_SIZE,
+    use_native: Optional[bool] = None,
+    seed: int = 42,
+    complex_name: str = "",
+) -> Dict:
     for name, ch in (("left", chain1), ("right", chain2)):
         if ch.num_atoms > constants.ATOM_COUNT_LIMIT:
             logger.warning(
@@ -132,7 +180,9 @@ def convert_pdb_pair_to_complex(
     else:
         contact_map = np.zeros((len(chain1), len(chain2)), dtype=np.int32)
     examples = build_examples(contact_map)
-    name = complex_name or f"{left_pdb}:{right_pdb}"
     if output_npz:
-        save_complex_npz(output_npz, raw1, raw2, examples, complex_name=name)
-    return {"graph1": raw1, "graph2": raw2, "examples": examples, "complex_name": name}
+        save_complex_npz(output_npz, raw1, raw2, examples, complex_name=complex_name)
+    return {
+        "graph1": raw1, "graph2": raw2, "examples": examples,
+        "complex_name": complex_name,
+    }

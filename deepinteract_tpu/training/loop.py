@@ -52,6 +52,16 @@ class LoopConfig:
     pos_prob_threshold: float = 0.5
     log_every: int = 100
     max_time_seconds: Optional[float] = None  # --max_hours/--max_minutes analog
+    # Stochastic weight averaging (reference --stochastic_weight_avg ->
+    # Lightning StochasticWeightAveraging, lit_model_train.py:157-159):
+    # average params over epochs from swa_epoch_start on; the averaged
+    # weights replace the trained ones when the loop ends.
+    swa: bool = False
+    swa_epoch_start: float = 0.8
+    # Log predicted vs true contact-map images to the metric writer every N
+    # epochs (0 = off) — the reference's viz branch
+    # (deepinteract_modules.py:1808-1884, images at :1850-1881).
+    viz_every_n_epochs: int = 0
 
 
 class EarlyStopping:
@@ -237,6 +247,9 @@ class Trainer:
         epochs = num_epochs if num_epochs is not None else cfg.num_epochs
         t_start = time.time()
         stop = False
+        swa_params = None
+        swa_count = 0
+        swa_first_epoch = int(math.ceil(cfg.swa_epoch_start * epochs))
 
         for epoch in range(start_epoch, epochs):
             t_epoch = time.time()
@@ -259,6 +272,12 @@ class Trainer:
             }
             if val_data is not None:
                 epoch_metrics.update(self.evaluate(state, val_data, stage="val"))
+                if (
+                    cfg.viz_every_n_epochs
+                    and self.metric_writer is not None
+                    and (epoch + 1) % cfg.viz_every_n_epochs == 0
+                ):
+                    self._log_viz_images(state, val_data, epoch)
             history.append(epoch_metrics)
             self._write_metrics(epoch, epoch_metrics)
             self.log(
@@ -269,6 +288,16 @@ class Trainer:
                     and not math.isnan(v)
                 )
             )
+
+            if cfg.swa and epoch >= swa_first_epoch:
+                p = jax.tree_util.tree_map(np.asarray, state.params)
+                if swa_params is None:
+                    swa_params, swa_count = p, 1
+                else:
+                    swa_count += 1
+                    swa_params = jax.tree_util.tree_map(
+                        lambda a, b: a + (b - a) / swa_count, swa_params, p
+                    )
 
             if ckpt is not None:
                 ckpt.save(epoch + 1, state_to_tree(state), epoch_metrics)
@@ -286,6 +315,9 @@ class Trainer:
             if stop:
                 break
 
+        if cfg.swa and swa_params is not None:
+            self.log(f"SWA: averaged {swa_count} epoch snapshot(s) into final params")
+            state = state.replace(params=jax.device_put(swa_params))
         if ckpt is not None:
             ckpt.close()
         return state, history
@@ -298,6 +330,25 @@ class Trainer:
 
             return shard_batch(batch, self.mesh)
         return batch
+
+    def _log_viz_images(self, state: TrainState, val_data: DataSource, epoch: int):
+        """Predicted-probability and ground-truth contact maps of the first
+        validation complex as TensorBoard images (reference viz epochs,
+        deepinteract_modules.py:1850-1881)."""
+        batch = next(iter(_iter_data(val_data, 0)), None)
+        if batch is None:
+            return
+        batch = self._device_batch(batch)
+        out = self._eval_step(state, batch)
+        probs = np.asarray(out["probs"])[0, ..., -1]  # [L1, L2] positive class
+        n1 = int(np.asarray(batch.graph1.num_nodes)[0])
+        n2 = int(np.asarray(batch.graph2.num_nodes)[0])
+        pred = (probs[:n1, :n2, None] * 255).astype(np.uint8)
+        true = (np.asarray(batch.contact_map)[0, :n1, :n2, None] * 255).astype(np.uint8)
+        self.metric_writer.add_image("val_predicted_contact_probs", pred, epoch,
+                                     dataformats="HWC")
+        self.metric_writer.add_image("val_true_contacts", true, epoch,
+                                     dataformats="HWC")
 
     def _write_metrics(self, epoch: int, metrics: Dict[str, float]) -> None:
         if self.metric_writer is None:

@@ -276,6 +276,24 @@ class TestPairAssembly:
         assert pc.graph1.node_feats.shape[0] >= n1
 
 
+class TestBoundComplexConverter:
+    def test_two_chain_complex(self, tmp_path):
+        from deepinteract_tpu.pipeline.pair import convert_bound_complex_to_pair
+
+        path = str(tmp_path / "complex.pdb")
+        a = _write_helix_pdb(str(tmp_path / "a.pdb"), n_res=21, chain="A")
+        b = _write_helix_pdb(str(tmp_path / "b.pdb"), n_res=22, chain="B")
+        with open(path, "w") as f:
+            f.write(open(a).read().replace("END\n", "") + open(b).read())
+        raw = convert_bound_complex_to_pair(path, "A", "B")
+        assert raw["graph1"]["node_feats"].shape == (21, constants.NUM_NODE_FEATS)
+        assert raw["graph2"]["node_feats"].shape == (22, constants.NUM_NODE_FEATS)
+        # Identical helices at the same coordinates: heavily interfaced.
+        assert raw["examples"][:, 2].sum() > 0
+        with pytest.raises(ValueError, match="chain 'C' not found"):
+            convert_bound_complex_to_pair(path, "C", "B")
+
+
 class TestPredictFromPDB:
     def test_predict_cli_pdb_path(self, tmp_path):
         """Raw PDB pair -> predict CLI -> contact map artifacts (the

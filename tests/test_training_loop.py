@@ -112,3 +112,56 @@ def test_fine_tune_freezes_decoder(tmp_path, data, optim_cfg):
         np.testing.assert_array_equal(a, b)  # decoder frozen
     gnn_after = np.asarray(jax.tree_util.tree_leaves(ft2.params["gnn"])[0])
     assert not np.array_equal(gnn_before, gnn_after)  # encoder trains
+
+
+class _FakeWriter:
+    def __init__(self):
+        self.scalars = []
+        self.images = []
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append(tag)
+
+    def add_image(self, tag, img, step, dataformats=None):
+        self.images.append((tag, img.shape, dataformats))
+
+
+def test_swa_averages_params(data, optim_cfg):
+    import jax
+
+    model = tiny_model()
+    cfg = LoopConfig(num_epochs=2, ckpt_dir=None, log_every=0,
+                     swa=True, swa_epoch_start=0.0)
+    trainer = Trainer(model, cfg, optim_cfg, log_fn=lambda s: None)
+    state = trainer.init_state(data[0])
+    state_swa, _ = trainer.fit(state, data)
+
+    # Same run without SWA: final params differ from the SWA average.
+    cfg2 = LoopConfig(num_epochs=2, ckpt_dir=None, log_every=0, swa=False)
+    trainer2 = Trainer(model, cfg2, optim_cfg, log_fn=lambda s: None)
+    state2 = trainer2.init_state(data[0])
+    state_raw, _ = trainer2.fit(state2, data)
+
+    leaves_swa = jax.tree_util.tree_leaves(state_swa.params)
+    leaves_raw = jax.tree_util.tree_leaves(state_raw.params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves_swa)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(leaves_swa, leaves_raw)
+    )
+
+
+def test_viz_images_logged(data, optim_cfg):
+    model = tiny_model()
+    writer = _FakeWriter()
+    cfg = LoopConfig(num_epochs=1, ckpt_dir=None, log_every=0,
+                     viz_every_n_epochs=1)
+    trainer = Trainer(model, cfg, optim_cfg, log_fn=lambda s: None,
+                      metric_writer=writer)
+    state = trainer.init_state(data[0])
+    trainer.fit(state, data, val_data=data[:1])
+    tags = [t for t, _, _ in writer.images]
+    assert "val_predicted_contact_probs" in tags
+    assert "val_true_contacts" in tags
+    shape = writer.images[0][1]
+    assert shape == (20, 16, 1)  # unpadded [n1, n2, 1]
