@@ -55,6 +55,10 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                    help="decoder ResNet chunks")
     g.add_argument("--num_interact_hidden_channels", type=int, default=128)
     g.add_argument("--use_interact_attention", action="store_true")
+    g.add_argument("--remat", action="store_true",
+                   help="rematerialize decoder blocks in backward (cuts "
+                        "train-step HBM ~4x; required for batch 8 at "
+                        "128-pad on a 16G chip)")
     g.add_argument("--dropout_rate", type=float, default=0.2)
     g.add_argument("--attention_mode", choices=("scatter", "gather"), default="scatter",
                    help="scatter = reference-exact edge softmax; gather = "
@@ -84,6 +88,9 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
                    help="warm-start from --ckpt_name and freeze the decoder "
                         "(deepinteract_modules.py:1546-1557)")
     g.add_argument("--resume", action="store_true")
+    g.add_argument("--find_lr", action="store_true",
+                   help="run an LR range test before training and use its "
+                        "suggestion (lit_model_train.py:121-127)")
     g.add_argument("--stochastic_weight_avg", action="store_true",
                    help="average params over the last 20%% of epochs "
                         "(lit_model_train.py:157-159)")
@@ -140,13 +147,14 @@ def configs_from_args(
         num_channels=args.num_interact_hidden_channels,
         use_attention=args.use_interact_attention,
         dropout_rate=args.dropout_rate,
+        remat=args.remat,
     )
     from deepinteract_tpu.models.vision import DeepLabConfig
 
     model_cfg = ModelConfig(
         gnn=gnn,
         decoder=decoder,
-        deeplab=DeepLabConfig(dropout_rate=args.dropout_rate),
+        deeplab=DeepLabConfig(dropout_rate=args.dropout_rate, remat=args.remat),
         gnn_layer_type=args.gnn_layer_type,
         interact_module_type=args.interact_module_type,
         shard_pair_map=args.shard_pair_map or args.num_pair_shards > 1,

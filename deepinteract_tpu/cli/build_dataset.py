@@ -1,0 +1,150 @@
+"""Bulk dataset builder: a directory of PDB pairs -> npz dataset tree.
+
+The L1 "builder" entry point (reference:
+``project/datasets/builder/process_complexes_into_dicts.py`` +
+``partition_dataset_filenames.py``; orchestration at
+deepinteract_utils.py:611-850): featurize every complex, write
+``processed/<name>.npz``, filter by the reference's size limits, and emit
+``pairs-postprocessed-{train,val,test}.txt`` split files (random 80/20
+train/test with 25% of train as val — partition_dataset_filenames.py:44-110)
+so the result is immediately consumable by ``cli.train``.
+
+Input conventions (checked in order):
+  * ``<name>_l_*.pdb`` + ``<name>_r_*.pdb`` pairs anywhere under --input_dir
+    (the reference's left/right unbound naming, e.g. 4heq_l_u.pdb), or
+  * ``--bound --chain1 A --chain2 B``: every ``*.pdb`` is a bound complex
+    split into two chains.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+from typing import Dict, List, Tuple
+
+from deepinteract_tpu import constants
+
+
+def find_pairs(input_dir: str) -> List[Tuple[str, str, str]]:
+    """(name, left_path, right_path) for every _l_/_r_ pair found."""
+    lefts: Dict[str, str] = {}
+    rights: Dict[str, str] = {}
+    for dirpath, _, files in os.walk(input_dir):
+        for f in sorted(files):
+            if not f.endswith(".pdb"):
+                continue
+            base = f[: -len(".pdb")]
+            for tag, bucket in (("_l_", lefts), ("_r_", rights)):
+                if tag in base:
+                    bucket[base.split(tag)[0]] = os.path.join(dirpath, f)
+    names = sorted(set(lefts) & set(rights))
+    return [(n, lefts[n], rights[n]) for n in names]
+
+
+def write_splits(root: str, names: List[str], seed: int,
+                 train_frac: float = 0.8, val_frac_of_train: float = 0.25) -> None:
+    """Random 80/20 train/test, then 25% of train as val
+    (partition_dataset_filenames.py:44-110)."""
+    rng = random.Random(seed)
+    shuffled = names[:]
+    rng.shuffle(shuffled)
+    n_train_all = int(len(shuffled) * train_frac)
+    train_all, test = shuffled[:n_train_all], shuffled[n_train_all:]
+    n_val = int(len(train_all) * val_frac_of_train)
+    val, train = train_all[:n_val], train_all[n_val:]
+    for mode, chunk in (("train", train), ("val", val), ("test", test)):
+        with open(os.path.join(root, f"pairs-postprocessed-{mode}.txt"), "w") as f:
+            f.write("\n".join(chunk) + ("\n" if chunk else ""))
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--input_dir", required=True)
+    p.add_argument("--output_dir", required=True,
+                   help="dataset root; processed/ + split files land here")
+    p.add_argument("--bound", action="store_true",
+                   help="treat each .pdb as a bound complex of two chains")
+    p.add_argument("--chain1", default="A")
+    p.add_argument("--chain2", default="B")
+    p.add_argument("--knn", type=int, default=constants.KNN)
+    p.add_argument("--geo_nbrhd_size", type=int, default=constants.GEO_NBRHD_SIZE)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--no_size_filter", action="store_true",
+                   help="keep complexes beyond RESIDUE_COUNT_LIMIT (the "
+                        "tiled decoder can train on them)")
+    p.add_argument("--overwrite", action="store_true")
+    args = p.parse_args(argv)
+
+    from deepinteract_tpu.pipeline.pair import (
+        convert_bound_complex_to_pair,
+        convert_pdb_pair_to_complex,
+    )
+
+    processed = os.path.join(args.output_dir, "processed")
+    os.makedirs(processed, exist_ok=True)
+
+    if args.bound:
+        jobs = [
+            (os.path.splitext(f)[0], os.path.join(dirpath, f), None)
+            for dirpath, _, files in os.walk(args.input_dir)
+            for f in sorted(files) if f.endswith(".pdb")
+        ]
+    else:
+        jobs = find_pairs(args.input_dir)
+    if not jobs:
+        print("no input complexes found", file=sys.stderr)
+        return 1
+
+    kept: List[str] = []
+    t0 = time.time()
+    for i, (name, left, right) in enumerate(jobs):
+        out = os.path.join(processed, f"{name}.npz")
+        rel = f"{name}.npz"
+        if os.path.exists(out) and not args.overwrite:
+            kept.append(rel)
+            continue
+        try:
+            if args.bound:
+                raw = convert_bound_complex_to_pair(
+                    left, args.chain1, args.chain2, output_npz=None,
+                    knn=args.knn, geo_nbrhd_size=args.geo_nbrhd_size,
+                    seed=args.seed,
+                )
+            else:
+                raw = convert_pdb_pair_to_complex(
+                    left, right, output_npz=None,
+                    knn=args.knn, geo_nbrhd_size=args.geo_nbrhd_size,
+                    seed=args.seed, complex_name=name,
+                )
+        except Exception as exc:
+            print(f"[{i + 1}/{len(jobs)}] {name}: SKIPPED ({exc})", file=sys.stderr)
+            continue
+        n1 = raw["graph1"]["node_feats"].shape[0]
+        n2 = raw["graph2"]["node_feats"].shape[0]
+        if not args.no_size_filter and (
+            n1 > constants.RESIDUE_COUNT_LIMIT or n2 > constants.RESIDUE_COUNT_LIMIT
+        ):
+            # Reference size filter (partition_dataset_filenames.py:52-56).
+            print(f"[{i + 1}/{len(jobs)}] {name}: filtered ({n1}x{n2} residues)",
+                  file=sys.stderr)
+            continue
+        from deepinteract_tpu.data.io import save_complex_npz
+
+        save_complex_npz(out, raw["graph1"], raw["graph2"], raw["examples"],
+                         complex_name=name)
+        kept.append(rel)
+        print(f"[{i + 1}/{len(jobs)}] {name}: {n1}x{n2} residues, "
+              f"{int(raw['examples'][:, 2].sum())} contacts", file=sys.stderr)
+
+    write_splits(args.output_dir, kept, args.seed)
+    print(f"built {len(kept)} complexes into {args.output_dir} "
+          f"in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

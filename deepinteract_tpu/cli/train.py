@@ -57,6 +57,20 @@ def main(argv=None) -> int:
     )
 
     model = DeepInteract(model_cfg)
+
+    if args.find_lr:
+        # Optional LR range test before training (lit_model_train.py:121-127).
+        from itertools import islice
+
+        from deepinteract_tpu.training.lr_finder import lr_find
+
+        probe = list(islice(iter(train_loader), 8))
+        suggested, _ = lr_find(model, probe[0], probe, optim_cfg,
+                               seed=args.seed,
+                               weight_classes=args.weight_classes)
+        print(f"lr_find suggestion: {suggested:.2e} (was {optim_cfg.lr:.2e})")
+        optim_cfg = dataclasses.replace(optim_cfg, lr=suggested)
+
     mesh = make_mesh_from_args(args)
     trainer = Trainer(model, loop_cfg, optim_cfg, mesh=mesh,
                       metric_writer=make_metric_writer(args))

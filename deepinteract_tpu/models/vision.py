@@ -62,6 +62,10 @@ class DeepLabConfig:
     output_stride: int = 16
     dropout_rate: float = 0.2
 
+    # Rematerialize encoder blocks in backward (same flag/semantics as
+    # DecoderConfig.remat; nn.remat preserves the param tree).
+    remat: bool = False
+
     def __post_init__(self):
         if self.output_stride != 16:
             raise ValueError("DeepLabConfig.output_stride must be 16 (see comment)")
@@ -148,10 +152,17 @@ class ResNetEncoder(nn.Module):
         x = ConvNormAct(cfg.stem_channels, 7, 2)(x, m2)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         m4 = _pool_mask(mask, 4)
+        # Max pooling at the pad frontier picks up valid neighbors, making
+        # padded pixels nonzero; re-zero before the stage convs read them
+        # (every masked InstanceNorm re-zeroes after its conv, so this is
+        # the one spot where unmasked values could smear into the valid
+        # region).
+        x = x * m4[..., None]
 
         skip = None
         m = m4
         scale = 4
+        block_cls = nn.remat(BasicBlock) if cfg.remat else BasicBlock
         for s, (feats, blocks) in enumerate(zip(cfg.stage_channels, cfg.stage_blocks)):
             # Stage strides 1,2,2,(dilated 1): output stride 16 overall.
             if s == 0:
@@ -164,7 +175,7 @@ class ResNetEncoder(nn.Module):
                 scale *= 2
                 m = _pool_mask(mask, scale)
             for b in range(blocks):
-                x = BasicBlock(
+                x = block_cls(
                     feats, stride=stride if b == 0 else 1, dilation=dilation,
                     name=f"stage{s}_block{b}",
                 )(x, m)

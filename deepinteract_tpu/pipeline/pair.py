@@ -119,9 +119,9 @@ def convert_bound_complex_to_pair(
             raise ValueError(
                 f"chain {cid!r} not found in {pdb_path}; has {sorted(chains)}"
             )
+    kwargs.setdefault("complex_name", f"{pdb_path}:{chain1}-{chain2}")
     return _convert_structures(
-        chains[chain1], chains[chain2], output_npz=output_npz,
-        complex_name=f"{pdb_path}:{chain1}-{chain2}", **kwargs,
+        chains[chain1], chains[chain2], output_npz=output_npz, **kwargs,
     )
 
 

@@ -318,6 +318,15 @@ class Trainer:
         if cfg.swa and swa_params is not None:
             self.log(f"SWA: averaged {swa_count} epoch snapshot(s) into final params")
             state = state.replace(params=jax.device_put(swa_params))
+            # Batch-norm statistics were accumulated for the last-epoch
+            # weights; refresh them for the averaged weights (Lightning's
+            # StochasticWeightAveraging does the same BN-update pass).
+            state = self._refresh_batch_stats(state, train_data)
+            if ckpt is not None and history:
+                # Persist the SWA weights so cli.test/predict load what the
+                # reported metrics were computed with.
+                ckpt.save(history[-1]["epoch"] + 2, state_to_tree(state),
+                          history[-1])
         if ckpt is not None:
             ckpt.close()
         return state, history
@@ -330,6 +339,25 @@ class Trainer:
 
             return shard_batch(batch, self.mesh)
         return batch
+
+    def _refresh_batch_stats(self, state: TrainState, train_data: DataSource) -> TrainState:
+        """One forward pass over the training data in train mode, updating
+        only batch statistics (no gradients)."""
+        import functools
+
+        @functools.partial(jax.jit, static_argnums=())
+        def stats_step(s, batch):
+            _, mutated = s.apply_fn(
+                {"params": s.params, "batch_stats": s.batch_stats},
+                batch.graph1, batch.graph2, train=True,
+                rngs={"dropout": s.dropout_rng},
+                mutable=["batch_stats"],
+            )
+            return s.replace(batch_stats=mutated["batch_stats"])
+
+        for batch in _iter_data(train_data, 0):
+            state = stats_step(state, self._device_batch(batch))
+        return state
 
     def _log_viz_images(self, state: TrainState, val_data: DataSource, epoch: int):
         """Predicted-probability and ground-truth contact maps of the first

@@ -1,0 +1,103 @@
+"""Learning-rate range finder (reference: the optional
+``trainer.tuner.lr_find`` step, lit_model_train.py:121-127, gated by
+``--find_lr``).
+
+Sweeps the learning rate geometrically from ``min_lr`` to ``max_lr`` over
+``num_steps`` train steps on a throwaway copy of the model state, records
+the loss per step, stops early on divergence (loss > 4x the running best,
+Lightning's rule), and suggests the lr at the steepest descent of the
+smoothed curve (Lightning's ``suggestion()``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+import optax
+
+from deepinteract_tpu.data.graph import PairedComplex
+from deepinteract_tpu.models.model import DeepInteract
+from deepinteract_tpu.training.optim import OptimConfig
+from deepinteract_tpu.training.steps import TrainState, train_step
+
+
+def lr_find(
+    model: DeepInteract,
+    example: PairedComplex,
+    data: Iterable[PairedComplex],
+    optim_cfg: Optional[OptimConfig] = None,
+    min_lr: float = 1e-6,
+    max_lr: float = 1.0,
+    num_steps: int = 30,
+    seed: int = 42,
+    weight_classes: bool = False,
+) -> Tuple[float, List[Tuple[float, float]]]:
+    """Returns (suggested_lr, [(lr, loss), ...]).
+
+    ``data`` is cycled if shorter than ``num_steps``. The sweep state is
+    discarded; callers re-init training with the suggestion.
+    """
+    cfg = optim_cfg or OptimConfig()
+    ratio = max_lr / min_lr
+
+    def schedule(step):
+        return min_lr * ratio ** (step / max(num_steps - 1, 1))
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.adamw(learning_rate=schedule, weight_decay=cfg.weight_decay),
+    )
+
+    root = jax.random.PRNGKey(seed)
+    params_rng, dropout_rng = jax.random.split(root)
+    variables = model.init(
+        {"params": params_rng, "dropout": dropout_rng},
+        example.graph1, example.graph2, train=False,
+    )
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=tx,
+        batch_stats=variables.get("batch_stats", {}),
+        dropout_rng=dropout_rng,
+    )
+
+    step_fn = jax.jit(lambda s, b: train_step(s, b, weight_classes=weight_classes))
+
+    batches = list(data)
+    history: List[Tuple[float, float]] = []
+    best = np.inf
+    for i in range(num_steps):
+        batch = batches[i % len(batches)]
+        lr = float(schedule(i))
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])
+        history.append((lr, loss))
+        if np.isfinite(loss):
+            best = min(best, loss)
+        if not np.isfinite(loss) or loss > 4.0 * best:
+            break  # diverged (Lightning early-stop rule)
+
+    return suggest_lr(history), history
+
+
+def suggest_lr(history: List[Tuple[float, float]]) -> float:
+    """Steepest negative gradient of the smoothed loss-vs-log(lr) curve."""
+    if len(history) < 4:
+        return history[len(history) // 2][0] if history else 1e-3
+    lrs = np.array([h[0] for h in history])
+    losses = np.array([h[1] for h in history])
+    finite = np.isfinite(losses)
+    lrs, losses = lrs[finite], losses[finite]
+    if len(losses) < 4:
+        return 1e-3
+    # Exponential smoothing, then finite-difference gradient in log-lr.
+    smoothed = np.empty_like(losses)
+    acc = losses[0]
+    for i, l in enumerate(losses):
+        acc = 0.7 * acc + 0.3 * l
+        smoothed[i] = acc
+    grads = np.gradient(smoothed, np.log(lrs))
+    return float(lrs[int(np.argmin(grads))])

@@ -1,0 +1,127 @@
+"""Dataset-builder CLI, download checksums, and LR finder tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.test_pipeline import _write_helix_pdb
+
+
+class TestBuildDatasetCLI:
+    def test_pairs_to_dataset_tree(self, tmp_path):
+        from deepinteract_tpu.cli import build_dataset
+
+        src = tmp_path / "raw"
+        os.makedirs(src)
+        for name in ("aaaa", "bbbb", "cccc", "dddd", "eeee"):
+            _write_helix_pdb(str(src / f"{name}_l_u.pdb"), n_res=21)
+            _write_helix_pdb(str(src / f"{name}_r_u.pdb"), n_res=22)
+        out = str(tmp_path / "ds")
+        rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
+                                 "--knn", "4"])
+        assert rc == 0
+        for mode in ("train", "val", "test"):
+            assert os.path.exists(os.path.join(out, f"pairs-postprocessed-{mode}.txt"))
+        names = sorted(os.listdir(os.path.join(out, "processed")))
+        assert names == ["aaaa.npz", "bbbb.npz", "cccc.npz", "dddd.npz", "eeee.npz"]
+
+        # Splits partition the kept complexes disjointly (80/20 + 25% val).
+        splits = {}
+        for mode in ("train", "val", "test"):
+            with open(os.path.join(out, f"pairs-postprocessed-{mode}.txt")) as f:
+                splits[mode] = [l.strip() for l in f if l.strip()]
+        all_names = sorted(sum(splits.values(), []))
+        assert all_names == names
+        assert len(splits["test"]) == 1  # 20% of 5
+        assert len(splits["val"]) == 1  # 25% of the 4 train
+
+        # The tree drives the dataset layer directly.
+        from deepinteract_tpu.data.datasets import DIPSDataset
+
+        ds = DIPSDataset(out, mode="train")
+        item = ds[0]
+        assert item["graph1"]["node_feats"].shape[1] == 113
+
+        # Idempotent re-run: existing npz kept, no overwrite.
+        rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
+                                 "--knn", "4"])
+        assert rc == 0
+
+    def test_size_filter(self, tmp_path):
+        from deepinteract_tpu.cli import build_dataset
+        from deepinteract_tpu import constants
+
+        src = tmp_path / "raw"
+        os.makedirs(src)
+        big = constants.RESIDUE_COUNT_LIMIT + 8
+        _write_helix_pdb(str(src / "big_l_u.pdb"), n_res=big)
+        _write_helix_pdb(str(src / "big_r_u.pdb"), n_res=21)
+        out = str(tmp_path / "ds")
+        rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
+                                 "--knn", "4"])
+        assert rc == 0
+        assert os.listdir(os.path.join(out, "processed")) == []
+
+        rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
+                                 "--knn", "4", "--no_size_filter", "--overwrite"])
+        assert rc == 0
+        assert os.listdir(os.path.join(out, "processed")) == ["big.npz"]
+
+
+class TestDownload:
+    def test_sha1_verification(self, tmp_path):
+        from deepinteract_tpu.data.download import download_and_verify, sha1_of
+
+        src = tmp_path / "artifact.bin"
+        src.write_bytes(b"deepinteract-tpu")
+        digest = sha1_of(str(src))
+        dest = str(tmp_path / "fetched.bin")
+        # file:// URL keeps the test offline.
+        out = download_and_verify(f"file://{src}", dest, sha1=digest)
+        assert out == dest and os.path.exists(dest)
+        # Existing + valid: no re-download. Existing + wrong hash: error.
+        download_and_verify(f"file://{src}", dest, sha1=digest)
+        with pytest.raises(ValueError, match="sha1"):
+            download_and_verify(f"file://{src}", dest, sha1="0" * 40)
+        # Fresh download with wrong expected hash fails and leaves nothing.
+        dest2 = str(tmp_path / "bad.bin")
+        with pytest.raises(ValueError, match="sha1 mismatch"):
+            download_and_verify(f"file://{src}", dest2, sha1="0" * 40)
+        assert not os.path.exists(dest2)
+
+
+class TestLRFinder:
+    def test_sweep_and_suggestion(self):
+        from deepinteract_tpu.data.graph import stack_complexes
+        from deepinteract_tpu.data.synthetic import random_complex
+        from deepinteract_tpu.models.decoder import DecoderConfig
+        from deepinteract_tpu.models.geometric_transformer import GTConfig
+        from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+        from deepinteract_tpu.training.lr_finder import lr_find, suggest_lr
+
+        rng = np.random.default_rng(3)
+        batches = [
+            stack_complexes([random_complex(16, 14, rng=rng, n_pad1=16, n_pad2=16,
+                                            knn=4, geo_nbrhd_size=2)])
+            for _ in range(2)
+        ]
+        model = DeepInteract(ModelConfig(
+            gnn=GTConfig(num_layers=1, hidden=8, num_heads=2, dropout_rate=0.0),
+            decoder=DecoderConfig(num_chunks=1, num_channels=4, dilation_cycle=(1,)),
+        ))
+        lr, history = lr_find(model, batches[0], batches, num_steps=8,
+                              min_lr=1e-5, max_lr=1e-1)
+        assert 1e-5 <= lr <= 1e-1
+        assert 2 <= len(history) <= 8
+        assert all(np.isfinite(l) or i == len(history) - 1
+                   for i, (_, l) in enumerate(history))
+
+    def test_suggest_lr_picks_steepest_descent(self):
+        from deepinteract_tpu.training.lr_finder import suggest_lr
+
+        # Loss flat, then steep drop at lr=1e-3, then blow-up.
+        history = [(1e-5, 1.0), (1e-4, 0.99), (3e-4, 0.95), (1e-3, 0.5),
+                   (3e-3, 0.4), (1e-2, 3.0)]
+        lr = suggest_lr(history)
+        assert 3e-4 <= lr <= 3e-3
