@@ -120,6 +120,7 @@ def main() -> None:
     # (label, batch, n1, n2, pad, remat). Kept to two buckets: each
     # train-step compile costs minutes on the TPU and the driver runs on a
     # budget.
+    scan_k = int(os.environ.get("DI_BENCH_SCAN", "8"))
     shapes = [
         ("b1_p128", 1, 100, 80, 128, False),
         ("b8_p128_remat", 8, 100, 80, 128, True),
@@ -149,6 +150,23 @@ def main() -> None:
 
             tstep = jax.jit(lambda s, b: train_step(s, b))
             tc, ts, tflops = _time_compiled(tstep, (state, batch))
+
+            # Scanned path: K steps per dispatch. Host dispatch cost scales
+            # with result-buffer count (~25 ms for the 3.4k-leaf state
+            # through the TPU tunnel), so the scan amortizes it K-fold —
+            # this is the throughput a real training run achieves
+            # (Trainer steps_per_dispatch, training/steps.py).
+            from deepinteract_tpu.training.steps import (
+                multi_train_step,
+                stack_microbatches,
+            )
+
+            k = scan_k
+            stacked = stack_microbatches([batch] * k)
+            mstep = jax.jit(lambda s, bs: multi_train_step(s, bs))
+            mc, ms, _ = _time_compiled(mstep, (state, stacked), iters=max(ITERS // 4, 3))
+            scan_ms_per_step = ms * 1e3 / k
+            scan_cps = bs * k / ms
         except Exception as exc:  # one bucket failing must not kill the run
             msg = str(exc).splitlines()[0][:300] if str(exc) else repr(exc)
             detail["buckets"][label] = {"error": msg}
@@ -158,7 +176,7 @@ def main() -> None:
                 # headline bucket fails: emit value 0 so the driver records
                 # a failed measurement instead of an empty file.
                 print(json.dumps({
-                    "metric": "train_step_complexes_per_sec_b1_p128",
+                    "metric": f"train_complexes_per_sec_b1_p128_scan{scan_k}",
                     "value": 0.0, "unit": "complexes/s", "vs_baseline": 0.0,
                 }), flush=True)
             continue
@@ -169,6 +187,10 @@ def main() -> None:
             "forward_complexes_per_sec": bs / fs,
             "train_ms": ts * 1e3, "train_compile_s": tc,
             "train_complexes_per_sec": bs / ts,
+            "train_scan_k": k,
+            "train_scan_ms_per_step": scan_ms_per_step,
+            "train_scan_complexes_per_sec": scan_cps,
+            "train_scan_compile_s": mc,
         }
         if fflops:
             entry["forward_flops"] = fflops
@@ -183,9 +205,12 @@ def main() -> None:
             # Emit the contract line as soon as the headline bucket is done:
             # later buckets may exceed the driver's wall-clock budget on a
             # cold compile cache, and the stdout line must not be lost.
-            value = headline["train_complexes_per_sec"]
+            # Headline = scanned train throughput (what a real training run
+            # sustains); the per-dispatch single-step figure stays in the
+            # detail entry.
+            value = headline["train_scan_complexes_per_sec"]
             print(json.dumps({
-                "metric": "train_step_complexes_per_sec_b1_p128",
+                "metric": f"train_complexes_per_sec_b1_p128_scan{k}",
                 "value": round(value, 2),
                 "unit": "complexes/s",
                 "vs_baseline": round(value / CPU_BASELINE_COMPLEXES_PER_SEC, 2),

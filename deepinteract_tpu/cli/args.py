@@ -78,6 +78,9 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--grad_clip_norm", type=float, default=0.5)
     g.add_argument("--num_epochs", type=int, default=50)
     g.add_argument("--accumulate_grad_batches", type=int, default=1)
+    g.add_argument("--steps_per_dispatch", type=int, default=8,
+                   help="train steps scanned per device dispatch; amortizes "
+                        "host round-trip cost (1 = classic per-step)")
     g.add_argument("--patience", type=int, default=5)
     g.add_argument("--min_delta", type=float, default=5e-6)
     g.add_argument("--metric_to_track", type=str, default="val_ce")
@@ -180,6 +183,7 @@ def configs_from_args(
         max_time_seconds=args.max_hours * 3600 if args.max_hours else None,
         swa=args.stochastic_weight_avg,
         viz_every_n_epochs=args.viz_every_n_epochs,
+        steps_per_dispatch=args.steps_per_dispatch,
     )
     return model_cfg, optim_cfg, loop_cfg
 

@@ -19,7 +19,6 @@ Input conventions (checked in order):
 from __future__ import annotations
 
 import os
-import random
 import sys
 import time
 from typing import Dict, List, Tuple
@@ -27,8 +26,16 @@ from typing import Dict, List, Tuple
 from deepinteract_tpu import constants
 
 
+def _unique_name(path: str, input_dir: str) -> str:
+    """Collision-free complex name: the path relative to the input root with
+    separators flattened ('setA/1abc' and 'setB/1abc' stay distinct)."""
+    rel = os.path.relpath(path, input_dir)
+    return os.path.splitext(rel)[0].replace(os.sep, "__")
+
+
 def find_pairs(input_dir: str) -> List[Tuple[str, str, str]]:
-    """(name, left_path, right_path) for every _l_/_r_ pair found."""
+    """(name, left_path, right_path) for every _l_/_r_ pair found (pairs are
+    matched within their directory; names stay unique across directories)."""
     lefts: Dict[str, str] = {}
     rights: Dict[str, str] = {}
     for dirpath, _, files in os.walk(input_dir):
@@ -38,25 +45,11 @@ def find_pairs(input_dir: str) -> List[Tuple[str, str, str]]:
             base = f[: -len(".pdb")]
             for tag, bucket in (("_l_", lefts), ("_r_", rights)):
                 if tag in base:
-                    bucket[base.split(tag)[0]] = os.path.join(dirpath, f)
+                    stem = base.split(tag)[0]
+                    key = _unique_name(os.path.join(dirpath, stem), input_dir)
+                    bucket[key] = os.path.join(dirpath, f)
     names = sorted(set(lefts) & set(rights))
     return [(n, lefts[n], rights[n]) for n in names]
-
-
-def write_splits(root: str, names: List[str], seed: int,
-                 train_frac: float = 0.8, val_frac_of_train: float = 0.25) -> None:
-    """Random 80/20 train/test, then 25% of train as val
-    (partition_dataset_filenames.py:44-110)."""
-    rng = random.Random(seed)
-    shuffled = names[:]
-    rng.shuffle(shuffled)
-    n_train_all = int(len(shuffled) * train_frac)
-    train_all, test = shuffled[:n_train_all], shuffled[n_train_all:]
-    n_val = int(len(train_all) * val_frac_of_train)
-    val, train = train_all[:n_val], train_all[n_val:]
-    for mode, chunk in (("train", train), ("val", val), ("test", test)):
-        with open(os.path.join(root, f"pairs-postprocessed-{mode}.txt"), "w") as f:
-            f.write("\n".join(chunk) + ("\n" if chunk else ""))
 
 
 def main(argv=None) -> int:
@@ -89,7 +82,8 @@ def main(argv=None) -> int:
 
     if args.bound:
         jobs = [
-            (os.path.splitext(f)[0], os.path.join(dirpath, f), None)
+            (_unique_name(os.path.join(dirpath, f), args.input_dir),
+             os.path.join(dirpath, f), None)
             for dirpath, _, files in os.walk(args.input_dir)
             for f in sorted(files) if f.endswith(".pdb")
         ]
@@ -99,13 +93,16 @@ def main(argv=None) -> int:
         print("no input complexes found", file=sys.stderr)
         return 1
 
-    kept: List[str] = []
+    from deepinteract_tpu.data import analysis
+    from deepinteract_tpu.data.io import complex_lengths, load_complex_npz
+
+    kept: List[Tuple[str, int, int]] = []  # (rel npz name, n1, n2)
     t0 = time.time()
     for i, (name, left, right) in enumerate(jobs):
         out = os.path.join(processed, f"{name}.npz")
         rel = f"{name}.npz"
         if os.path.exists(out) and not args.overwrite:
-            kept.append(rel)
+            kept.append((rel, *complex_lengths(load_complex_npz(out))))
             continue
         try:
             if args.bound:
@@ -125,24 +122,32 @@ def main(argv=None) -> int:
             continue
         n1 = raw["graph1"]["node_feats"].shape[0]
         n2 = raw["graph2"]["node_feats"].shape[0]
-        if not args.no_size_filter and (
-            n1 > constants.RESIDUE_COUNT_LIMIT or n2 > constants.RESIDUE_COUNT_LIMIT
-        ):
-            # Reference size filter (partition_dataset_filenames.py:52-56).
-            print(f"[{i + 1}/{len(jobs)}] {name}: filtered ({n1}x{n2} residues)",
-                  file=sys.stderr)
-            continue
         from deepinteract_tpu.data.io import save_complex_npz
 
+        os.makedirs(os.path.dirname(out), exist_ok=True)
         save_complex_npz(out, raw["graph1"], raw["graph2"], raw["examples"],
                          complex_name=name)
-        kept.append(rel)
+        kept.append((rel, n1, n2))
         print(f"[{i + 1}/{len(jobs)}] {name}: {n1}x{n2} residues, "
               f"{int(raw['examples'][:, 2].sum())} contacts", file=sys.stderr)
 
-    write_splits(args.output_dir, kept, args.seed)
-    print(f"built {len(kept)} complexes into {args.output_dir} "
-          f"in {time.time() - t0:.1f}s")
+    # One split implementation for the whole framework: the reference's
+    # size-filter + 80/20 + 25%-val partition (analysis.partition_filenames,
+    # partition_dataset_filenames.py:44-110). --no_size_filter keeps
+    # over-limit complexes (the tiled decoder can train on them).
+    no_filter = args.no_size_filter
+    splits = analysis.partition_filenames(
+        kept, seed=args.seed,
+        max_residues=10 ** 9 if no_filter else constants.RESIDUE_COUNT_LIMIT,
+        max_pairs=10 ** 18 if no_filter else None,
+    )
+    analysis.write_split_files(args.output_dir, splits)
+    n_split = sum(len(v) for v in splits.values())
+    if n_split < len(kept):
+        print(f"size filter dropped {len(kept) - n_split} complex(es) from "
+              f"the splits (npz files kept on disk)", file=sys.stderr)
+    print(f"built {len(kept)} complexes ({n_split} in splits) into "
+          f"{args.output_dir} in {time.time() - t0:.1f}s")
     return 0
 
 

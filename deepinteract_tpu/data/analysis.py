@@ -81,15 +81,18 @@ def partition_filenames(
     test_frac: float = 0.2,
     val_frac_of_train: float = 0.25,
     max_residues: int = constants.RESIDUE_COUNT_LIMIT,
+    max_pairs: Optional[int] = None,
 ) -> Dict[str, List[str]]:
     """Size-filter + random split (reference
     ``builder/partition_dataset_filenames.py:44-110``: drops complexes whose
     chains exceed the residue limit or whose pair count exceeds 256^2, then
-    80/20 train/test with 25% of train as val)."""
+    80/20 train/test with 25% of train as val). ``max_pairs`` defaults to
+    the reference's RESIDUE_COUNT_LIMIT^2 pair-area cap."""
+    if max_pairs is None:
+        max_pairs = constants.RESIDUE_COUNT_LIMIT ** 2
     eligible = [
         name for name, n1, n2 in names_and_lengths
-        if n1 <= max_residues and n2 <= max_residues
-        and n1 * n2 < constants.RESIDUE_COUNT_LIMIT ** 2
+        if n1 <= max_residues and n2 <= max_residues and n1 * n2 < max_pairs
     ]
     rng = random.Random(seed)
     rng.shuffle(eligible)

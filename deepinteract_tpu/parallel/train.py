@@ -35,6 +35,24 @@ def make_sharded_train_step(mesh: Mesh, weight_classes: bool = False, donate: bo
     )
 
 
+def make_sharded_multi_step(mesh: Mesh, weight_classes: bool = False, donate: bool = True):
+    """Sharded :func:`deepinteract_tpu.training.steps.multi_train_step`:
+    the stacked batch is [K, B, ...] with the scan axis unsharded and the
+    batch axis split over ``data``."""
+    from deepinteract_tpu.training.steps import multi_train_step
+
+    replicated = NamedSharding(mesh, P())
+    batch_sharded = NamedSharding(mesh, P(None, DATA_AXIS))
+
+    step = partial(multi_train_step, weight_classes=weight_classes, axis_name=None)
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch_sharded),
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
 def make_sharded_eval_step(mesh: Mesh, weight_classes: bool = False):
     from deepinteract_tpu.training.steps import eval_step
 

@@ -92,6 +92,41 @@ def train_step(
     return new_state, metrics
 
 
+def multi_train_step(
+    state: TrainState,
+    batches: PairedComplex,
+    weight_classes: bool = False,
+    axis_name: Optional[str] = None,
+) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+    """K optimization steps in ONE dispatch: ``lax.scan`` over batches
+    stacked on a leading axis ([K, B, ...] per leaf).
+
+    Motivation (TPU-native, no reference equivalent): host dispatch cost
+    scales with the number of result buffers — on this TPU tunnel, merely
+    returning the ~3.4k-leaf train state costs ~25 ms per call, an order of
+    magnitude more than the device compute of a train step. Scanning K
+    steps keeps the state on device across all K updates and pays the
+    round-trip once, so per-step overhead drops ~K-fold. Semantics are
+    identical to K sequential ``train_step`` calls (parity-tested).
+
+    Returns (final state, metrics with a leading [K] axis).
+    """
+
+    def body(s, b):
+        s, m = train_step(s, b, weight_classes=weight_classes, axis_name=axis_name)
+        return s, m
+
+    return jax.lax.scan(body, state, batches)
+
+
+def stack_microbatches(batches):
+    """Stack same-shape PairedComplex batches along a new leading axis for
+    :func:`multi_train_step`."""
+    import numpy as np
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *batches)
+
+
 def eval_step(
     state: TrainState, batch: PairedComplex, weight_classes: bool = False
 ) -> Dict[str, jnp.ndarray]:

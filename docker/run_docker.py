@@ -1,0 +1,60 @@
+"""Docker launcher for containerized prediction.
+
+Reference equivalent: ``docker/run_docker.py`` (absl CLI assembling mounts
+and the container invocation for ``lit_model_predict_docker.py``). Same
+shape here with argparse: mount the input PDBs, checkpoint, and output
+directory, then run the image whose entrypoint is the predict CLI.
+
+  python docker/run_docker.py --left_pdb l.pdb --right_pdb r.pdb \
+      --ckpt_dir ckpts/ --output_dir out/ [--image deepinteract-tpu]
+
+NOTE: authored and reviewed but not run-tested in the development
+environment (no docker daemon available there).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--left_pdb", required=True)
+    p.add_argument("--right_pdb", required=True)
+    p.add_argument("--ckpt_dir", default=None)
+    p.add_argument("--output_dir", default="out")
+    p.add_argument("--image", default="deepinteract-tpu")
+    p.add_argument("--docker_bin", default="docker")
+    p.add_argument("extra", nargs=argparse.REMAINDER,
+                   help="extra args forwarded to the predict CLI")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    mounts = []
+    cli = []
+    # Separate mount dirs: left/right files may share a basename.
+    for flag, side, host in (("--left_pdb", "left", args.left_pdb),
+                             ("--right_pdb", "right", args.right_pdb)):
+        host = os.path.abspath(host)
+        tgt = f"/inputs/{side}/{os.path.basename(host)}"
+        mounts += ["-v", f"{host}:{tgt}:ro"]
+        cli += [flag, tgt]
+    out_abs = os.path.abspath(args.output_dir)
+    mounts += ["-v", f"{out_abs}:/outputs"]
+    cli += ["--output_dir", "/outputs"]
+    if args.ckpt_dir:
+        ckpt_abs = os.path.abspath(args.ckpt_dir)
+        mounts += ["-v", f"{ckpt_abs}:/ckpt:ro"]
+        cli += ["--ckpt_name", "/ckpt"]
+
+    cmd = [args.docker_bin, "run", "--rm", *mounts, args.image, *cli,
+           *[a for a in args.extra if a != "--"]]
+    print("+", " ".join(cmd), file=sys.stderr)
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

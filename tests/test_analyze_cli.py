@@ -1,0 +1,84 @@
+"""Analyze CLI subcommands + docker launcher command assembly."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.data.io import save_complex_npz
+
+from tests.test_data_layer import make_raw_complex
+
+
+@pytest.fixture(scope="module")
+def tree(tmp_path_factory):
+    rng = np.random.default_rng(5)
+    root = tmp_path_factory.mktemp("ds")
+    os.makedirs(root / "processed")
+    names = []
+    for i, (n1, n2) in enumerate([(20, 16), (24, 18), (22, 20), (18, 22)]):
+        raw = make_raw_complex(n1, n2, rng)
+        save_complex_npz(str(root / "processed" / f"c{i}.npz"), raw["graph1"],
+                         raw["graph2"], raw["examples"], f"c{i}")
+        names.append(f"c{i}.npz")
+    return str(root), names
+
+
+def test_stats_and_lengths_and_partition(tree, capsys, tmp_path):
+    from deepinteract_tpu.cli import analyze
+
+    root, names = tree
+    assert analyze.main(["stats", "--root", root,
+                         "--csv_out", str(tmp_path / "s.csv")]) == 0
+    agg = json.loads(capsys.readouterr().out)
+    assert agg["num_complexes"] == 4
+    assert os.path.exists(str(tmp_path / "s.csv"))
+
+    assert analyze.main(["lengths", "--root", root]) == 0
+    lens = json.loads(capsys.readouterr().out)
+    assert lens["max"] == 24 and lens["over_limit_frac"] == 0.0
+
+    assert analyze.main(["partition", "--root", root, "--seed", "1"]) == 0
+    counts = json.loads(capsys.readouterr().out)
+    assert sum(counts.values()) == 4
+    for mode in ("train", "val", "test"):
+        assert os.path.exists(os.path.join(root, f"pairs-postprocessed-{mode}.txt"))
+
+
+def test_leakage_detects_identical_chains(tree, capsys):
+    from deepinteract_tpu.cli import analyze
+
+    root, names = tree
+    # Make train and test share a complex -> guaranteed identity leak.
+    with open(os.path.join(root, "pairs-postprocessed-train.txt"), "w") as f:
+        f.write(names[0] + "\n")
+    with open(os.path.join(root, "pairs-postprocessed-test.txt"), "w") as f:
+        f.write(names[0] + "\n")
+    rc = analyze.main(["leakage", "--root", root])
+    out = capsys.readouterr().out
+    assert rc == 1 and "LEAK" in out
+
+
+def test_run_docker_command_assembly(tmp_path, capsys):
+    sys.path.insert(0, "docker")
+    try:
+        import run_docker
+    finally:
+        sys.path.pop(0)
+
+    left = tmp_path / "l.pdb"
+    right = tmp_path / "r.pdb"
+    left.write_text("END\n")
+    right.write_text("END\n")
+    rc = run_docker.main([
+        "--left_pdb", str(left), "--right_pdb", str(right),
+        "--ckpt_dir", str(tmp_path), "--output_dir", str(tmp_path / "out"),
+        "--docker_bin", "echo",
+    ])
+    assert rc == 0  # `echo` stands in for docker
+    err = capsys.readouterr().err
+    assert "/inputs/left/l.pdb:ro" in err and "/inputs/right/r.pdb:ro" in err
+    assert "--ckpt_name /ckpt" in err
+    assert os.path.isdir(tmp_path / "out")

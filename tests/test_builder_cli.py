@@ -61,12 +61,43 @@ class TestBuildDatasetCLI:
         rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
                                  "--knn", "4"])
         assert rc == 0
-        assert os.listdir(os.path.join(out, "processed")) == []
+        # npz is written, but the over-limit complex is excluded from splits
+        # (reference partition filter).
+        assert os.listdir(os.path.join(out, "processed")) == ["big.npz"]
+        split_names = []
+        for mode in ("train", "val", "test"):
+            with open(os.path.join(out, f"pairs-postprocessed-{mode}.txt")) as f:
+                split_names += [l.strip() for l in f if l.strip()]
+        assert split_names == []
 
         rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
-                                 "--knn", "4", "--no_size_filter", "--overwrite"])
+                                 "--knn", "4", "--no_size_filter"])
         assert rc == 0
-        assert os.listdir(os.path.join(out, "processed")) == ["big.npz"]
+        split_names = []
+        for mode in ("train", "val", "test"):
+            with open(os.path.join(out, f"pairs-postprocessed-{mode}.txt")) as f:
+                split_names += [l.strip() for l in f if l.strip()]
+        assert split_names == ["big.npz"]
+
+    def test_same_stem_in_different_dirs_stays_distinct(self, tmp_path):
+        from deepinteract_tpu.cli import build_dataset
+
+        src = tmp_path / "raw"
+        for sub in ("setA", "setB"):
+            os.makedirs(src / sub)
+            _write_helix_pdb(str(src / sub / "1abc_l_u.pdb"), n_res=21)
+            _write_helix_pdb(str(src / sub / "1abc_r_u.pdb"), n_res=22)
+        out = str(tmp_path / "ds")
+        rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
+                                 "--knn", "4"])
+        assert rc == 0
+        names = sorted(os.listdir(os.path.join(out, "processed")))
+        assert names == ["setA__1abc.npz", "setB__1abc.npz"]
+        split_names = []
+        for mode in ("train", "val", "test"):
+            with open(os.path.join(out, f"pairs-postprocessed-{mode}.txt")) as f:
+                split_names += [l.strip() for l in f if l.strip()]
+        assert sorted(split_names) == names  # disjoint, no duplicates
 
 
 class TestDownload:

@@ -69,3 +69,41 @@ def test_graft_entry_dryrun():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_sharded_multi_step(rng):
+    """make_sharded_multi_step: K scanned steps on the mesh advance the
+    state K steps and agree with K sequential sharded steps."""
+    from deepinteract_tpu.parallel.train import make_sharded_multi_step
+    from deepinteract_tpu.training.steps import stack_microbatches
+
+    model, _ = tiny(4, rng, shard_pair=True)
+    batches = [
+        stack_complexes(
+            [random_complex(26, 22, rng=rng, n_pad1=32, n_pad2=32, knn=8)
+             for _ in range(4)]
+        )
+        for _ in range(2)
+    ]
+    mesh = make_mesh(num_data=4, num_pair=2)
+    with jax.set_mesh(mesh):
+        state = create_train_state(model, batches[0], seed=1,
+                                   optim_cfg=OptimConfig(steps_per_epoch=2, num_epochs=2))
+        state = replicate(state, mesh)
+
+        step = make_sharded_train_step(mesh, donate=False)
+        seq_state = state
+        seq_losses = []
+        for b in batches:
+            seq_state, m = step(seq_state, shard_batch(b, mesh))
+            seq_losses.append(float(m["loss"]))
+
+        mstep = make_sharded_multi_step(mesh, donate=False)
+        scan_state, stacked = mstep(state, stack_microbatches(batches))
+
+    scan_losses = [float(l) for l in np.asarray(stacked["loss"])]
+    np.testing.assert_allclose(scan_losses, seq_losses, rtol=1e-5, atol=1e-6)
+    assert int(scan_state.step) == 2
+    for a, b in zip(jax.tree_util.tree_leaves(seq_state.params),
+                    jax.tree_util.tree_leaves(scan_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
