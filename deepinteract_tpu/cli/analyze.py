@@ -61,20 +61,19 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from deepinteract_tpu.data import analysis
-    from deepinteract_tpu.data.io import load_complex_npz
 
     if args.cmd == "stats":
         agg = analysis.collect_statistics(_processed_paths(args.root),
                                           csv_out=args.csv_out)
         print(json.dumps(agg))
     elif args.cmd == "partition":
+        from deepinteract_tpu.data.io import complex_lengths_from_file
+
         paths = _processed_paths(args.root)
         nl = []
         for path in paths:
-            raw = load_complex_npz(path)
             rel = os.path.relpath(path, os.path.join(args.root, "processed"))
-            nl.append((rel, raw["graph1"]["node_feats"].shape[0],
-                       raw["graph2"]["node_feats"].shape[0]))
+            nl.append((rel, *complex_lengths_from_file(path)))
         splits = analysis.partition_filenames(nl, seed=args.seed)
         analysis.write_split_files(args.root, splits)
         print(json.dumps({k: len(v) for k, v in splits.items()}))

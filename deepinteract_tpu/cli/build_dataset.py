@@ -26,11 +26,13 @@ from typing import Dict, List, Tuple
 from deepinteract_tpu import constants
 
 
-def _unique_name(path: str, input_dir: str) -> str:
-    """Collision-free complex name: the path relative to the input root with
-    separators flattened ('setA/1abc' and 'setB/1abc' stay distinct)."""
-    rel = os.path.relpath(path, input_dir)
-    return os.path.splitext(rel)[0].replace(os.sep, "__")
+def _unique_name(path_no_ext: str, input_dir: str) -> str:
+    """Collision-free complex name: the extension-less path relative to the
+    input root with separators flattened ('setA/1abc' and 'setB/1abc' stay
+    distinct). The caller strips the extension — stripping here would
+    corrupt dotted stems like '1abc.pdb1'."""
+    rel = os.path.relpath(path_no_ext, input_dir)
+    return rel.replace(os.sep, "__")
 
 
 def find_pairs(input_dir: str) -> List[Tuple[str, str, str]]:
@@ -82,7 +84,7 @@ def main(argv=None) -> int:
 
     if args.bound:
         jobs = [
-            (_unique_name(os.path.join(dirpath, f), args.input_dir),
+            (_unique_name(os.path.join(dirpath, f[: -len(".pdb")]), args.input_dir),
              os.path.join(dirpath, f), None)
             for dirpath, _, files in os.walk(args.input_dir)
             for f in sorted(files) if f.endswith(".pdb")
@@ -94,7 +96,7 @@ def main(argv=None) -> int:
         return 1
 
     from deepinteract_tpu.data import analysis
-    from deepinteract_tpu.data.io import complex_lengths, load_complex_npz
+    from deepinteract_tpu.data.io import complex_lengths_from_file
 
     kept: List[Tuple[str, int, int]] = []  # (rel npz name, n1, n2)
     t0 = time.time()
@@ -102,7 +104,7 @@ def main(argv=None) -> int:
         out = os.path.join(processed, f"{name}.npz")
         rel = f"{name}.npz"
         if os.path.exists(out) and not args.overwrite:
-            kept.append((rel, *complex_lengths(load_complex_npz(out))))
+            kept.append((rel, *complex_lengths_from_file(out)))
             continue
         try:
             if args.bound:

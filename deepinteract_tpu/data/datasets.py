@@ -22,7 +22,7 @@ import random
 from typing import Dict, List, Optional
 
 from deepinteract_tpu import constants
-from deepinteract_tpu.data.io import complex_lengths, load_complex_npz
+from deepinteract_tpu.data.io import complex_lengths_from_file, load_complex_npz
 
 
 class ComplexDataset:
@@ -102,12 +102,9 @@ class ComplexDataset:
         return raw
 
     def lengths(self) -> List[tuple]:
-        """(n1, n2) per item, reading only headers (cheap bucket planning)."""
-        out = []
-        for i in range(len(self)):
-            raw = load_complex_npz(self.path_of(i))
-            out.append(complex_lengths(raw))
-        return out
+        """(n1, n2) per item, reading only npy headers (cheap bucket
+        planning over thousands of complexes — no array decompression)."""
+        return [complex_lengths_from_file(self.path_of(i)) for i in range(len(self))]
 
 
 class DIPSDataset(ComplexDataset):

@@ -106,3 +106,31 @@ def to_paired_complex(
 
 def complex_lengths(raw: Dict) -> Tuple[int, int]:
     return raw["graph1"]["node_feats"].shape[0], raw["graph2"]["node_feats"].shape[0]
+
+
+def complex_lengths_from_file(path: str) -> Tuple[int, int]:
+    """(n1, n2) read from npy headers only — no array decompression.
+
+    Bucket planning and builder resume scan whole dataset trees for
+    lengths; loading every array to read two shapes would turn those
+    scans into full-dataset deserialization.
+    """
+    import zipfile
+
+    header_readers = {
+        (1, 0): np.lib.format.read_array_header_1_0,
+        (2, 0): np.lib.format.read_array_header_2_0,
+    }
+    with zipfile.ZipFile(path) as z:
+        out = []
+        for member in ("g1_node_feats.npy", "g2_node_feats.npy"):
+            with z.open(member) as f:
+                version = np.lib.format.read_magic(f)
+                reader = header_readers.get(tuple(version))
+                if reader is None:  # unknown npy version: load the array
+                    f2 = z.open(member)
+                    out.append(int(np.lib.format.read_array(f2).shape[0]))
+                    continue
+                shape, _, _ = reader(f)
+                out.append(int(shape[0]))
+    return out[0], out[1]

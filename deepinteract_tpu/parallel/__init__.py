@@ -8,4 +8,12 @@ distributed generalization of the reference's 256x256 subsequencing tiles).
 """
 
 from deepinteract_tpu.parallel.mesh import make_mesh, shard_batch, replicate  # noqa: F401
-from deepinteract_tpu.parallel.train import make_sharded_train_step  # noqa: F401
+from deepinteract_tpu.parallel.multihost import (  # noqa: F401
+    initialize_distributed,
+    is_primary_host,
+    shard_filenames_for_host,
+)
+from deepinteract_tpu.parallel.train import (  # noqa: F401
+    make_sharded_multi_step,
+    make_sharded_train_step,
+)

@@ -1,0 +1,91 @@
+"""Multi-host (multi-process) initialization and per-host data sharding.
+
+The reference scales across nodes with Lightning DDP over torch.distributed
+(``--num_compute_nodes`` -> ``args.num_nodes``, lit_model_train.py:217,226;
+NCCL backend). The TPU-native equivalent needs no custom communication
+layer: ``jax.distributed.initialize`` wires every host into one runtime,
+``jax.devices()`` then spans the whole slice/pod, and the same GSPMD-jitted
+step (``parallel/train.py``) runs unchanged — XLA routes collectives over
+ICI within a slice and DCN across slices.
+
+What the framework must still do itself (this module):
+* initialize the distributed runtime idempotently, honoring both TPU
+  auto-detection and explicit coordinator env vars;
+* shard the *data pipeline* per host — each process feeds only its own
+  shard of the complex list (the DistributedSampler analog Lightning
+  injects, SURVEY.md §2.6) — while batches keep their global meaning under
+  ``jax.make_array_from_process_local_data``.
+
+Single-host callers can ignore this module entirely; everything degrades
+to process_count() == 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Idempotently initialize the multi-process JAX runtime.
+
+    On TPU pods all arguments auto-detect from the environment; elsewhere
+    pass coordinator/num_processes/process_id explicitly (or set the
+    standard JAX_COORDINATOR_ADDRESS etc.). Returns the process index.
+    Safe to call when already initialized or single-process.
+
+    Must run before anything touches the XLA backend (even
+    ``jax.process_count()`` initializes it, after which distributed init
+    is rejected) — call it first thing in the training entry point.
+    """
+    # Idempotency via the distributed client itself: process_count() would
+    # initialize the XLA backend and make a later initialize() impossible.
+    state = getattr(jax.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return jax.process_index()  # already initialized
+    explicit = any(
+        v is not None for v in (coordinator_address, num_processes, process_id)
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        if explicit:
+            # The caller asked for a specific topology; degrading to
+            # single-process here would silently split-brain the run.
+            raise
+        # Auto-detection found no distributed environment: single-process.
+    return jax.process_index()
+
+
+def shard_filenames_for_host(
+    filenames: Sequence[str],
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> list:
+    """This host's contiguous shard of the (already shuffled) complex list
+    — the DistributedSampler analog. Every host must receive the same
+    ``filenames`` ordering (same seed) for shards to be disjoint."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if pc <= 1:
+        return list(filenames)
+    # Drop the remainder so every host runs the same number of steps (a
+    # straggler host would deadlock collectives at epoch end).
+    per_host = len(filenames) // pc
+    start = pi * per_host
+    return list(filenames[start : start + per_host])
+
+
+def is_primary_host() -> bool:
+    """True on the process that should write checkpoints/logs (rank-0
+    semantics of the reference's Lightning callbacks)."""
+    return jax.process_index() == 0

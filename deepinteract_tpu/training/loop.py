@@ -367,9 +367,14 @@ class Trainer:
             nonlocal buffer
             if not buffer:
                 return state
-            if len(buffer) == 1:
-                state, metrics = self._train_step(state, self._device_batch(buffer[0]))
-                log_step(metrics)
+            if len(buffer) == 1 or len(buffer) < k:
+                # Single batch, or a remainder shorter than K: run single
+                # steps on the already-compiled per-step path — a scan over
+                # an odd length would trigger a fresh multi-minute XLA
+                # compile to run once per epoch.
+                for b in buffer:
+                    state, metrics = self._train_step(state, self._device_batch(b))
+                    log_step(metrics)
             else:
                 # Buffered batches stay on host; they are stacked here and
                 # placed once by the jitted multi-step's in_shardings (one

@@ -79,6 +79,36 @@ class TestBuildDatasetCLI:
                 split_names += [l.strip() for l in f if l.strip()]
         assert split_names == ["big.npz"]
 
+    def test_dotted_stems_stay_distinct(self, tmp_path):
+        """DIPS-style names like 1abc.pdb1 / 1abc.pdb2 must not collapse."""
+        from deepinteract_tpu.cli import build_dataset
+
+        src = tmp_path / "raw"
+        os.makedirs(src)
+        for stem in ("1abc.pdb1", "1abc.pdb2"):
+            _write_helix_pdb(str(src / f"{stem}_l_u.pdb"), n_res=21)
+            _write_helix_pdb(str(src / f"{stem}_r_u.pdb"), n_res=22)
+        out = str(tmp_path / "ds")
+        rc = build_dataset.main(["--input_dir", str(src), "--output_dir", out,
+                                 "--knn", "4"])
+        assert rc == 0
+        names = sorted(os.listdir(os.path.join(out, "processed")))
+        assert names == ["1abc.pdb1.npz", "1abc.pdb2.npz"]
+
+    def test_lazy_length_reader(self, tmp_path):
+        import numpy as np
+
+        from deepinteract_tpu.data.io import (
+            complex_lengths_from_file,
+            save_complex_npz,
+        )
+        from tests.test_data_layer import make_raw_complex
+
+        raw = make_raw_complex(19, 23, np.random.default_rng(0))
+        path = str(tmp_path / "c.npz")
+        save_complex_npz(path, raw["graph1"], raw["graph2"], raw["examples"], "c")
+        assert complex_lengths_from_file(path) == (19, 23)
+
     def test_same_stem_in_different_dirs_stays_distinct(self, tmp_path):
         from deepinteract_tpu.cli import build_dataset
 

@@ -107,3 +107,21 @@ def test_sharded_multi_step(rng):
     for a, b in zip(jax.tree_util.tree_leaves(seq_state.params),
                     jax.tree_util.tree_leaves(scan_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_multihost_helpers_single_process():
+    """Single-process degradation of the multi-host utilities."""
+    from deepinteract_tpu.parallel.multihost import (
+        initialize_distributed,
+        is_primary_host,
+        shard_filenames_for_host,
+    )
+
+    assert initialize_distributed() == 0
+    assert is_primary_host()
+    names = [f"c{i}" for i in range(10)]
+    assert shard_filenames_for_host(names) == names
+    # Explicit 3-host split: disjoint contiguous shards, remainder dropped.
+    shards = [shard_filenames_for_host(names, pi, 3) for pi in range(3)]
+    assert all(len(s) == 3 for s in shards)
+    assert len({n for s in shards for n in s}) == 9

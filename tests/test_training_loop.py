@@ -212,3 +212,50 @@ def test_trainer_steps_per_dispatch_equivalent(data, optim_cfg):
         results.append((history[0]["train_loss"], int(state.step)))
     assert results[0][1] == results[1][1] == len(data)
     np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-5)
+
+
+def test_model_learns_single_complex(optim_cfg):
+    """Learning-capacity check: overfitting one synthetic complex must
+    drive the loss well below its initial value and rank true contacts
+    highly (the closest in-repo analog of the reference's model-quality
+    evaluation; published checkpoints are not available offline)."""
+    import jax
+
+    from deepinteract_tpu.training import metrics as M
+    from deepinteract_tpu.training.steps import (
+        create_train_state,
+        eval_step,
+        multi_train_step,
+        stack_microbatches,
+    )
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    rng = np.random.default_rng(11)
+    batch = stack_complexes(
+        [random_complex(24, 20, rng=rng, n_pad1=32, n_pad2=32, knn=6,
+                        geo_nbrhd_size=2)]
+    )
+    model = tiny_model()
+    state = create_train_state(
+        model, batch, optim_cfg=OptimConfig(lr=3e-3, steps_per_epoch=10, num_epochs=10)
+    )
+    first = float(jax.jit(eval_step)(state, batch)["loss"])
+
+    stacked = stack_microbatches([batch] * 10)
+    mstep = jax.jit(multi_train_step)
+    for _ in range(6):  # 60 steps total
+        state, ms = mstep(state, stacked)
+    last = float(np.asarray(ms["loss"])[-1])
+    assert last < 0.25 * first, (first, last)
+
+    out = jax.jit(eval_step)(state, batch)
+    probs = np.asarray(out["probs"])[0]
+    examples = np.asarray(batch.examples)[0]
+    mask = np.asarray(batch.example_mask)[0]
+    pos_probs, labels = M.gather_pair_predictions(probs, examples, mask)
+    m = M.complex_metrics(pos_probs, labels, 24, 20, stage="test")
+    # 60 steps of a 16-hidden model: ranking must be far above chance
+    # (random top-10 precision ~= the positive rate, ~10% on this synthetic
+    # complex; AUROC chance = 0.5).
+    assert m["auroc"] >= 0.85, m
+    assert m["top_10_prec"] >= 0.4, m
