@@ -103,19 +103,31 @@ def main() -> None:
 
     import dataclasses
 
-    model = DeepInteract(ModelConfig())
+    # DI_BENCH_DTYPE=bfloat16 measures the bf16 decoder activation path
+    # (params/logits stay f32; see DecoderConfig.compute_dtype).
+    bench_dtype = os.environ.get("DI_BENCH_DTYPE", "float32")
+    if bench_dtype not in ("float32", "bfloat16"):
+        raise SystemExit(
+            f"DI_BENCH_DTYPE must be 'float32' or 'bfloat16', got {bench_dtype!r}"
+        )
+    base_cfg = ModelConfig(
+        decoder=dataclasses.replace(
+            ModelConfig().decoder, compute_dtype=bench_dtype
+        )
+    )
+    model = DeepInteract(base_cfg)
     # The batch-8 train step exceeds a 16G v5e's HBM with full activation
     # storage; remat (decoder-block rematerialization) is the intended
     # config at that scale. Param trees are identical, so the same state
     # drives both models.
     model_remat = DeepInteract(
         dataclasses.replace(
-            ModelConfig(),
-            decoder=dataclasses.replace(ModelConfig().decoder, remat=True),
+            base_cfg,
+            decoder=dataclasses.replace(base_cfg.decoder, remat=True),
         )
     )
     detail = {"backend": dev.platform, "device_kind": dev.device_kind,
-              "iters": ITERS, "buckets": {}}
+              "iters": ITERS, "compute_dtype": bench_dtype, "buckets": {}}
 
     # (label, batch, n1, n2, pad, remat). Kept to two buckets: each
     # train-step compile costs minutes on the TPU and the driver runs on a

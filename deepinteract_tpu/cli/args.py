@@ -55,6 +55,10 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                    help="decoder ResNet chunks")
     g.add_argument("--num_interact_hidden_channels", type=int, default=128)
     g.add_argument("--use_interact_attention", action="store_true")
+    g.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
+                   default="float32",
+                   help="decoder activation dtype; bfloat16 halves HBM "
+                        "traffic (params/norm stats/logits stay float32)")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize decoder blocks in backward (cuts "
                         "train-step HBM ~4x; required for batch 8 at "
@@ -151,9 +155,15 @@ def configs_from_args(
         use_attention=args.use_interact_attention,
         dropout_rate=args.dropout_rate,
         remat=args.remat,
+        compute_dtype=args.compute_dtype,
     )
     from deepinteract_tpu.models.vision import DeepLabConfig
 
+    if args.interact_module_type == "deeplab" and args.compute_dtype != "float32":
+        raise SystemExit(
+            "--compute_dtype bfloat16 is implemented for the dilated decoder "
+            "only; the DeepLabV3+ path runs float32"
+        )
     model_cfg = ModelConfig(
         gnn=gnn,
         decoder=decoder,

@@ -43,6 +43,20 @@ class DecoderConfig:
     # train step OOMs a 16G v5e chip without it). No reference equivalent —
     # torch keeps all activations. Param tree is identical either way.
     remat: bool = False
+    # Activation compute dtype for the conv stack ('float32' | 'bfloat16').
+    # bfloat16 halves HBM traffic on the pair-map activations; params stay
+    # float32 and instance-norm statistics are computed in float32
+    # regardless (masked_instance_norm upcasts), so the param/checkpoint
+    # tree is unchanged. Final logits are float32. Measured on a v5e at
+    # 128-pad: neutral-to-slightly-slower (2.99 vs 2.82 ms/step scanned —
+    # XLA already runs f32 convs through bf16 MXU passes, so only the
+    # bandwidth saving is new, and 128x128 maps are not bandwidth-bound);
+    # intended for larger pair maps / batch sizes.
+    compute_dtype: str = "float32"
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
 
 
 def masked_instance_norm(x: jnp.ndarray, mask: Optional[jnp.ndarray], scale, bias, eps=1e-6):
@@ -50,8 +64,12 @@ def masked_instance_norm(x: jnp.ndarray, mask: Optional[jnp.ndarray], scale, bia
 
     x: [B, H, W, C]; mask: [B, H, W] or None. Reference uses
     ``nn.InstanceNorm2d(eps=1e-06, affine=True)`` on unpadded maps; masking
-    makes the padded formulation equivalent.
+    makes the padded formulation equivalent. Statistics are always computed
+    in float32 (bf16 spatial sums lose too much precision); the result is
+    cast back to the input dtype.
     """
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32)
     if mask is None:
         mean = jnp.mean(x, axis=(1, 2), keepdims=True)
         var = jnp.var(x, axis=(1, 2), keepdims=True)
@@ -63,7 +81,7 @@ def masked_instance_norm(x: jnp.ndarray, mask: Optional[jnp.ndarray], scale, bia
     y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps)) * scale + bias
     if mask is not None:
         y = y * mask[..., None]
-    return y
+    return y.astype(in_dtype)
 
 
 class InstanceNorm(nn.Module):
@@ -82,18 +100,21 @@ class SEBlock(nn.Module):
 
     channels: int
     ratio: int = 16
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask=None):
+        xf = x.astype(jnp.float32)  # f32 spatial mean, like the norms
         if mask is None:
-            pooled = jnp.mean(x, axis=(1, 2))
+            pooled = jnp.mean(xf, axis=(1, 2))
         else:
-            m = mask[..., None].astype(x.dtype)
-            pooled = jnp.sum(x * m, axis=(1, 2)) / jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0)
-        h = nn.relu(nn.Dense(max(1, self.channels // self.ratio))(pooled))
-        h = nn.relu(nn.Dense(self.channels)(h))
+            m = mask[..., None].astype(xf.dtype)
+            pooled = jnp.sum(xf * m, axis=(1, 2)) / jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0)
+        pooled = pooled.astype(self.dtype)
+        h = nn.relu(nn.Dense(max(1, self.channels // self.ratio), dtype=self.dtype)(pooled))
+        h = nn.relu(nn.Dense(self.channels, dtype=self.dtype)(h))
         gate = nn.sigmoid(h)
-        return x * gate[:, None, None, :]
+        return x * gate[:, None, None, :].astype(x.dtype)
 
 
 class BottleneckBlock(nn.Module):
@@ -104,6 +125,7 @@ class BottleneckBlock(nn.Module):
     channels: int
     dilation: int
     use_inorm: bool
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -112,7 +134,7 @@ class BottleneckBlock(nn.Module):
         if self.use_inorm:
             x = InstanceNorm(self.channels, name="inorm_1")(x, mask)
         x = nn.elu(x)
-        x = nn.Conv(half, (1, 1), name="conv2d_1")(x)
+        x = nn.Conv(half, (1, 1), dtype=self.dtype, name="conv2d_1")(x)
         if self.use_inorm:
             x = InstanceNorm(half, name="inorm_2")(x, mask)
         x = nn.elu(x)
@@ -122,19 +144,19 @@ class BottleneckBlock(nn.Module):
             # 3x3 would smear them into real pixels near the pad boundary.
             # With this mask, padded buckets match the reference's unpadded
             # zero-boundary conv behavior exactly.
-            x = x * mask[..., None]
+            x = x * mask[..., None].astype(x.dtype)
         x = nn.Conv(
             half, (3, 3), kernel_dilation=(self.dilation, self.dilation),
-            padding=self.dilation, name="conv2d_2",
+            padding=self.dilation, dtype=self.dtype, name="conv2d_2",
         )(x)
         if self.use_inorm:
             x = InstanceNorm(half, name="inorm_3")(x, mask)
         x = nn.elu(x)
-        x = nn.Conv(self.channels, (1, 1), name="conv2d_3")(x)
-        x = SEBlock(self.channels, name="se_block")(x, mask)
+        x = nn.Conv(self.channels, (1, 1), dtype=self.dtype, name="conv2d_3")(x)
+        x = SEBlock(self.channels, dtype=self.dtype, name="se_block")(x, mask)
         out = x + residual
         if mask is not None:
-            out = out * mask[..., None]
+            out = out * mask[..., None].astype(out.dtype)
         return out
 
 
@@ -150,6 +172,7 @@ class DilatedResNet(nn.Module):
     initial_projection: bool = False
     extra_blocks: bool = False
     remat: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -157,16 +180,18 @@ class DilatedResNet(nn.Module):
         # share one param/checkpoint tree.
         block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
         if self.initial_projection:
-            x = nn.Conv(self.channels, (1, 1), name="init_proj")(x)
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype, name="init_proj")(x)
         for i in range(self.num_chunks):
             for d in self.dilation_cycle:
                 x = block_cls(
-                    self.channels, d, self.use_inorm, name=f"block_{i}_{d}"
+                    self.channels, d, self.use_inorm, self.dtype,
+                    name=f"block_{i}_{d}",
                 )(x, mask)
         if self.extra_blocks:
             for i in range(2):
                 x = block_cls(
-                    self.channels, 1, self.use_inorm, name=f"extra_block_{i}"
+                    self.channels, 1, self.use_inorm, self.dtype,
+                    name=f"extra_block_{i}",
                 )(x, mask)
         return x
 
@@ -186,6 +211,7 @@ class RegionalAttention(nn.Module):
     num_heads: int = 4
     region_size: int = 3
     dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -195,10 +221,10 @@ class RegionalAttention(nn.Module):
             # Zeroing the padded region makes window slots that fall in the
             # pad behave exactly like the reference's zero-padded image
             # boundary (q/k/v are bias-free 1x1 convs, so qk = 0 there).
-            x = x * mask[..., None]
-        q = nn.Conv(self.d_k, (1, 1), use_bias=False, name="q_layer")(x)
-        k = nn.Conv(self.d_k, (1, 1), use_bias=False, name="k_layer")(x)
-        v = nn.Conv(self.channels, (1, 1), use_bias=False, name="v_layer")(x)
+            x = x * mask[..., None].astype(x.dtype)
+        q = nn.Conv(self.d_k, (1, 1), use_bias=False, dtype=self.dtype, name="q_layer")(x)
+        k = nn.Conv(self.d_k, (1, 1), use_bias=False, dtype=self.dtype, name="k_layer")(x)
+        v = nn.Conv(self.channels, (1, 1), use_bias=False, dtype=self.dtype, name="v_layer")(x)
 
         def patches(t):  # [B,H,W,C] -> [B,H,W,s*s,C]
             pad = s // 2
@@ -214,7 +240,10 @@ class RegionalAttention(nn.Module):
         n_head = self.num_heads
         dk_per_head = self.d_k // n_head
         qk = qk.reshape(b, hh, ww, s * s, n_head, dk_per_head).sum(-1)  # [B,H,W,s2,n_head]
-        att = nn.softmax(qk / jnp.sqrt(jnp.asarray(self.d_k, x.dtype)), axis=3)
+        # Softmax in f32 (bf16 exponentials lose too much), back to compute dtype.
+        att = nn.softmax(
+            qk.astype(jnp.float32) / jnp.sqrt(jnp.float32(self.d_k)), axis=3
+        ).astype(qk.dtype)
         att = nn.Dropout(self.dropout_rate, deterministic=not train)(att)
         v_p = patches(v).reshape(b, hh, ww, s * s, n_head, self.channels // n_head)
         out = jnp.einsum("bhwsn,bhwsnc->bhwnc", att, v_p).reshape(b, hh, ww, self.channels)
@@ -233,33 +262,37 @@ class InteractionDecoder(nn.Module):
     @nn.compact
     def __call__(self, pair_tensor: jnp.ndarray, mask=None, train: bool = False):
         cfg = self.cfg
-        x = nn.Conv(cfg.num_channels, (1, 1), name="conv2d_1")(pair_tensor)
+        dt = cfg.dtype
+        pair_tensor = pair_tensor.astype(dt)
+        x = nn.Conv(cfg.num_channels, (1, 1), dtype=dt, name="conv2d_1")(pair_tensor)
         x = nn.elu(InstanceNorm(cfg.num_channels, name="inorm_1")(x, mask))
 
         x = nn.elu(
             DilatedResNet(
                 cfg.num_channels, cfg.num_chunks, cfg.dilation_cycle,
                 use_inorm=True, initial_projection=True, remat=cfg.remat,
-                name="base_resnet",
+                dtype=dt, name="base_resnet",
             )(x, mask)
         )
         if cfg.use_attention:
             x = nn.elu(RegionalAttention(
                 cfg.num_channels, num_heads=cfg.num_attention_heads,
-                region_size=cfg.region_size, dropout_rate=cfg.dropout_rate, name="mha2d_1",
+                region_size=cfg.region_size, dropout_rate=cfg.dropout_rate,
+                dtype=dt, name="mha2d_1",
             )(x, mask, train))
 
         x = nn.elu(
             DilatedResNet(
                 cfg.num_channels, 1, cfg.dilation_cycle,
                 use_inorm=False, initial_projection=True, extra_blocks=True,
-                remat=cfg.remat, name="phase2_resnet",
+                remat=cfg.remat, dtype=dt, name="phase2_resnet",
             )(x, mask)
         )
         if cfg.use_attention:
             x = nn.elu(RegionalAttention(
                 cfg.num_channels, num_heads=cfg.num_attention_heads,
-                region_size=cfg.region_size, dropout_rate=cfg.dropout_rate, name="mha2d_2",
+                region_size=cfg.region_size, dropout_rate=cfg.dropout_rate,
+                dtype=dt, name="mha2d_2",
             )(x, mask, train))
 
         # Positive-class bias -7 => initial positive probability ~0.001
@@ -268,7 +301,9 @@ class InteractionDecoder(nn.Module):
             bias = jnp.zeros(shape, dtype)
             return bias.at[1].set(-7.0)
 
-        logits = nn.Conv(cfg.num_classes, (1, 1), bias_init=final_bias, name="phase2_conv")(x)
+        # Logits in float32 regardless of the activation dtype.
+        logits = nn.Conv(cfg.num_classes, (1, 1), bias_init=final_bias,
+                         name="phase2_conv")(x.astype(jnp.float32))
         if mask is not None:
             logits = logits * mask[..., None]
         return logits

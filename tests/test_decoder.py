@@ -138,3 +138,44 @@ def test_remat_matches_non_remat():
     g_rem = jax.grad(loss(rem))(variables["params"])
     for a, b in zip(jax.tree_util.tree_leaves(g_plain), jax.tree_util.tree_leaves(g_rem)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_bfloat16_compute_dtype():
+    """bf16 activation path: identical param tree, float32 logits, outputs
+    close to the f32 path within bf16 tolerance."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
+
+    cfg = DecoderConfig(num_chunks=1, in_channels=8, num_channels=8,
+                        dilation_cycle=(1, 2))
+    cfg_bf = dataclasses.replace(cfg, compute_dtype="bfloat16")
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 12, 10, 8))
+    mask = jnp.ones((1, 12, 10))
+    f32 = InteractionDecoder(cfg)
+    bf16 = InteractionDecoder(cfg_bf)
+    variables = f32.init(jax.random.PRNGKey(1), x, mask)
+    variables_bf = bf16.init(jax.random.PRNGKey(1), x, mask)
+    # Same param tree and dtypes (params stay float32).
+    assert jax.tree_util.tree_structure(variables) == jax.tree_util.tree_structure(variables_bf)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(variables_bf["params"]))
+
+    out32 = f32.apply(variables, x, mask)
+    out16 = bf16.apply(variables, x, mask)
+    assert out16.dtype == jnp.float32  # logits always f32
+    assert bool(jnp.isfinite(out16).all())
+    np.testing.assert_allclose(np.asarray(out32), np.asarray(out16),
+                               rtol=0.1, atol=0.1)
+
+    # Gradients flow and are finite through the bf16 path.
+    def loss(params):
+        return jnp.mean(bf16.apply({"params": params}, x, mask) ** 2)
+
+    g = jax.grad(loss)(variables["params"])
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(bool(jnp.isfinite(l).all()) for l in leaves)
+    assert all(l.dtype == jnp.float32 for l in leaves)
