@@ -16,7 +16,9 @@ batches.
 
 from __future__ import annotations
 
+import queue
 import random
+import threading
 from collections import defaultdict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -42,6 +44,7 @@ class BucketedLoader:
         drop_remainder: bool = False,
         seed: int = 42,
         pad_to_max_bucket: bool = False,
+        prefetch: int = 2,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -49,6 +52,9 @@ class BucketedLoader:
         self.drop_remainder = drop_remainder
         self.seed = seed
         self.pad_to_max_bucket = pad_to_max_bucket
+        # Batches ready ahead of the consumer on a background thread
+        # (npz load + pad + stack overlap device compute; 0 disables).
+        self.prefetch = prefetch
         # Bucket planning reads every header once, up front.
         self._buckets = self._plan()
 
@@ -91,7 +97,7 @@ class BucketedLoader:
             rng.shuffle(plan)  # interleave buckets across the epoch
         return plan
 
-    def iter_epoch(self, epoch: int = 0, with_targets: bool = False) -> Iterator:
+    def _produce(self, epoch: int, with_targets: bool) -> Iterator:
         for (b1, b2), chunk in self._epoch_plan(epoch):
             complexes, targets = [], []
             for idx in chunk:
@@ -106,6 +112,12 @@ class BucketedLoader:
             batch = stack_complexes(complexes)
             yield (batch, targets) if with_targets else batch
 
+    def iter_epoch(self, epoch: int = 0, with_targets: bool = False) -> Iterator:
+        if self.prefetch <= 0:
+            yield from self._produce(epoch, with_targets)
+            return
+        yield from _prefetched(self._produce(epoch, with_targets), self.prefetch)
+
     def targets(self) -> List[str]:
         """Target names in epoch-0 iteration order (for eval CSV export)."""
         out = []
@@ -118,6 +130,50 @@ class BucketedLoader:
 
     def __iter__(self) -> Iterator[PairedComplex]:
         return self.iter_epoch(0)
+
+
+def _prefetched(source: Iterator, depth: int) -> Iterator:
+    """Run ``source`` on a daemon thread, keeping up to ``depth`` items
+    ready. Exceptions propagate to the consumer. When the consumer abandons
+    the iterator early (break / GeneratorExit — e.g. taking one batch for
+    viz logging), the ``finally`` sets a stop flag the worker polls, so the
+    thread exits instead of blocking forever on a full queue with pinned
+    batches."""
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    done = object()
+    stop = threading.Event()
+
+    def put_guarded(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in source:
+                if not put_guarded(item):
+                    return
+        except BaseException as exc:  # noqa: BLE001 - re-raised on consumer side
+            put_guarded((done, exc))
+            return
+        put_guarded((done, None))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is done:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        stop.set()
 
 
 class InMemoryDataset:

@@ -217,3 +217,65 @@ def test_loader_feeds_model_finite_loss(rng):
     )
     loss = contact_loss(logits, batch.contact_map, batch.pair_mask, False)
     assert np.isfinite(float(loss))
+
+
+def test_prefetch_yields_identical_batches():
+    """Background prefetch must not change batch content or order, and must
+    propagate producer exceptions."""
+    import jax
+    import numpy as np
+
+    from deepinteract_tpu.data.loader import BucketedLoader, InMemoryDataset, _prefetched
+
+    rng = np.random.default_rng(21)
+    raws = [make_raw_complex(n1, n2, rng) for n1, n2 in [(20, 16), (24, 18), (22, 20)]]
+    ds = InMemoryDataset(raws)
+    plain = BucketedLoader(ds, batch_size=1, shuffle=True, prefetch=0)
+    pref = BucketedLoader(ds, batch_size=1, shuffle=True, prefetch=2)
+    batches_a = list(plain.iter_epoch(3))
+    batches_b = list(pref.iter_epoch(3))
+    assert len(batches_a) == len(batches_b) == 3
+    for a, b in zip(batches_a, batches_b):
+        for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    # with_targets tuples pass through untouched.
+    wt = list(pref.iter_epoch(0, with_targets=True))
+    assert all(isinstance(t, list) for _, t in wt)
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer failed")
+
+    out = _prefetched(boom(), depth=2)
+    assert next(out) == 1
+    try:
+        next(out)
+        assert False, "expected RuntimeError"
+    except RuntimeError as e:
+        assert "producer failed" in str(e)
+
+
+def test_prefetch_worker_stops_on_abandonment():
+    """Abandoning a prefetched iterator must release the worker thread."""
+    import threading
+    import time
+
+    from deepinteract_tpu.data.loader import _prefetched
+
+    produced = []
+
+    def source():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = _prefetched(source(), depth=2)
+    assert next(it) == 0
+    it.close()  # GeneratorExit -> finally -> stop flag
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+    assert len(produced) < 100  # worker stopped early, not drained
