@@ -1,0 +1,144 @@
+"""Import a reference (torch/Lightning) checkpoint into this framework.
+
+The reference publishes trained weights (``README.md:249-253``, Zenodo
+record 6671582; restored by its test CLI at ``lit_model_test.py:121-130``).
+This CLI converts such a ``.ckpt``/``.pt``/``.npz`` into an orbax
+checkpoint directory that ``cli.test``, ``cli.predict`` and
+``--fine_tune --ckpt_name`` consume directly::
+
+    python -m deepinteract_tpu.cli.import_checkpoint \
+        --ckpt LitGINI-GeoTran-DilResNet.ckpt --out_dir imported/geotran
+
+Model hyperparameters are read from the Lightning checkpoint's
+``hyper_parameters`` blob when present (``save_hyperparameters()``,
+deepinteract_modules.py:1583); CLI flags override. Only ``params`` and
+``batch_stats`` are produced — the torch optimizer state is deliberately
+not translated (Adam moments do not transfer across frameworks'
+different update formulations); training continues via
+``--fine_tune``-style warm starts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+
+import numpy as np
+
+
+def load_reference_checkpoint(path: str):
+    """Load a checkpoint file into (state_dict of np arrays, hparams dict).
+
+    Supports Lightning ``.ckpt``/torch ``.pt`` (needs torch, present in
+    this image as CPU-only) and ``.npz``/pickled plain dicts of arrays.
+    """
+    if path.endswith(".npz"):
+        data = dict(np.load(path))
+        return data, {}
+    try:
+        import torch
+
+        blob = torch.load(path, map_location="cpu", weights_only=False)
+    except ModuleNotFoundError:
+        with open(path, "rb") as fh:
+            blob = pickle.load(fh)
+    if isinstance(blob, dict) and "state_dict" in blob:
+        sd, hparams = blob["state_dict"], dict(blob.get("hyper_parameters") or {})
+    else:
+        sd, hparams = blob, {}
+    out = {}
+    for key, value in sd.items():
+        out[key] = value.detach().cpu().numpy() if hasattr(value, "detach") else np.asarray(value)
+    return out, hparams
+
+
+def apply_hparams(args: argparse.Namespace, hparams: dict,
+                  parser: argparse.ArgumentParser, log=print) -> None:
+    """Overlay checkpoint hyperparameters onto parser defaults. Explicit CLI
+    flags win: an arg is only filled from the checkpoint while it still
+    holds its parser default."""
+
+    def fill(our_name, value):
+        if getattr(args, our_name) == parser.get_default(our_name):
+            setattr(args, our_name, value)
+            return 1
+        return 0
+
+    mapping = {
+        "num_gnn_layers": "num_gnn_layers",
+        "num_gnn_hidden_channels": "num_gnn_hidden_channels",
+        "num_gnn_attention_heads": "num_gnn_attention_heads",
+        "num_interact_layers": "num_interact_layers",
+        "num_interact_hidden_channels": "num_interact_hidden_channels",
+        "use_interact_attention": "use_interact_attention",
+        "disable_geometric_mode": "disable_geometric_mode",
+        "dropout_rate": "dropout_rate",
+    }
+    applied = 0
+    for ref_name, our_name in mapping.items():
+        if ref_name in hparams:
+            applied += fill(our_name, hparams[ref_name])
+    if "gnn_layer_type" in hparams:
+        applied += fill(
+            "gnn_layer_type",
+            "gcn" if str(hparams["gnn_layer_type"]).lower() == "gcn" else "geotran",
+        )
+    if "interact_module_type" in hparams:
+        applied += fill(
+            "interact_module_type",
+            "deeplab" if str(hparams["interact_module_type"]).lower() == "deeplab" else "dilated",
+        )
+    if applied:
+        log(f"applied {applied} checkpoint hyperparameters "
+            f"(of {len(hparams)} in the blob; explicit CLI flags kept)")
+
+
+def main(argv=None) -> int:
+    from deepinteract_tpu.cli.args import build_parser, configs_from_args
+
+    parser = build_parser(__doc__)
+    parser.add_argument("--ckpt", type=str, required=True,
+                        help="reference checkpoint file (.ckpt/.pt/.npz)")
+    parser.add_argument("--out_dir", type=str, required=True,
+                        help="orbax checkpoint directory to create")
+    parser.add_argument("--no_hparams", action="store_true",
+                        help="ignore the checkpoint's hyper_parameters blob")
+    args = parser.parse_args(argv)
+
+    sd, hparams = load_reference_checkpoint(args.ckpt)
+    if not args.no_hparams:
+        apply_hparams(args, hparams, parser)
+
+    if args.interact_module_type != "dilated" or args.gnn_layer_type not in ("geotran", "gcn"):
+        raise SystemExit(
+            "importer supports the published configurations: geotran/gcn GNN "
+            "with the dilated decoder (DeepLab import not implemented)"
+        )
+
+    from deepinteract_tpu.data.graph import stack_complexes
+    from deepinteract_tpu.data.synthetic import random_complex
+    from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig
+    from deepinteract_tpu.training.import_torch import convert_state_dict
+
+    model_cfg, _, _ = configs_from_args(args)
+    example = stack_complexes([random_complex(24, 20, np.random.default_rng(0))])
+    variables, report = convert_state_dict(sd, model_cfg, example)
+    print(report.summary())
+
+    ckpt = Checkpointer(CheckpointConfig(directory=args.out_dir, keep_last=False))
+    ckpt.save(
+        0,
+        {"step": np.asarray(0), "params": variables["params"],
+         "batch_stats": variables["batch_stats"]},
+        {"val_ce": 0.0},
+    )
+    ckpt.close()
+    print(f"wrote imported checkpoint to {args.out_dir} "
+          f"(use with --ckpt_name {args.out_dir} in cli.test/predict, or "
+          f"--fine_tune for decoder-frozen training)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,15 @@
 """Interaction-tensor construction: two chains' node features -> pair map.
 
 Reference: ``construct_interact_tensor`` (deepinteract_utils.py:158-172)
-interleaves (C, L1) and (C, L2) matrices into a (1, 2C, L1, L2) NCHW tensor.
-We produce NHWC ``[B, L1, L2, 2C]`` (TPU conv-native): channels [:C] are
-chain-1 features broadcast along columns, channels [C:] chain-2 features
-broadcast along rows. Padding is inherent — inputs arrive already padded,
-and the pair mask (outer product of node masks) travels with the tensor.
+concatenates the broadcast (C, L1) and (C, L2) matrices along the channel
+dim — ``torch.cat((repeat(x_a), repeat(x_b)), dim=1)`` — into a
+(1, 2C, L1, L2) NCHW tensor whose first C channels are chain-1 features.
+We produce NHWC ``[B, L1, L2, 2C]`` (TPU conv-native) with the SAME
+``[feats1 | feats2]`` channel order: channels [:C] are chain-1 features
+broadcast along columns, channels [C:] chain-2 features broadcast along
+rows — so checkpoint import (training/import_torch.py) needs no channel
+permutation. Padding is inherent — inputs arrive already padded, and the
+pair mask (outer product of node masks) travels with the tensor.
 """
 
 from __future__ import annotations

@@ -1,0 +1,358 @@
+"""Checkpoint-importer tests: mapping round-trip, strictness, and EXECUTED
+parity against the reference's own torch modules.
+
+The real Zenodo checkpoint (README.md:249-253) is unreachable offline, so:
+
+* Round-trip tests use :func:`synthesize_reference_state_dict` — a state
+  dict with the exact reference key names/shapes (incl. shared-norm
+  duplicate entries and ``num_batches_tracked`` decoys).
+* Executed-parity tests import the reference's *actual* pure-torch modules
+  (``ResNet2DInputWithOptAttention``, ``ResBlock``) from
+  ``/root/reference`` with DGL/Lightning stubbed out (those classes never
+  touch them), run a forward with torch, convert the live ``state_dict()``
+  through our importer, and require ``<=1e-4`` agreement from our flax
+  modules. This executes the reference code as an oracle only — nothing is
+  copied into this repo.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.data.graph import stack_complexes
+from deepinteract_tpu.data.synthetic import random_complex
+from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+from deepinteract_tpu.training.import_torch import (
+    convert_state_dict,
+    map_flax_path,
+    synthesize_reference_state_dict,
+)
+
+REFERENCE_ROOT = "/root/reference"
+HAVE_REFERENCE = os.path.isdir(os.path.join(REFERENCE_ROOT, "project", "utils"))
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def example():
+    return stack_complexes([random_complex(24, 20, np.random.default_rng(0))])
+
+
+@pytest.fixture(scope="module")
+def small_cfg():
+    import dataclasses
+
+    cfg = ModelConfig()
+    return dataclasses.replace(
+        cfg,
+        gnn=dataclasses.replace(cfg.gnn, num_layers=2),
+        decoder=dataclasses.replace(cfg.decoder, num_chunks=2),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mapping round-trip on a synthetic reference-layout state dict
+# ---------------------------------------------------------------------------
+
+
+class TestRoundTrip:
+    def test_all_keys_consumed_and_all_leaves_filled(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=1)
+        variables, report = convert_state_dict(sd, small_cfg, example)
+        assert not report.unconsumed
+        # every ignored key is a known decoy
+        assert all("num_batches_tracked" in k for k in report.ignored)
+        # params + batch_stats trees are complete: re-deriving the abstract
+        # tree and walking it must find a value at every leaf
+        from deepinteract_tpu.training.import_torch import (
+            _iter_leaf_paths,
+            abstract_variables,
+        )
+
+        abstract = abstract_variables(small_cfg, example)
+        for col in ("params", "batch_stats"):
+            for path, leaf in _iter_leaf_paths(abstract[col]):
+                node = variables[col]
+                for k in path:
+                    node = node[k]
+                assert node.shape == tuple(leaf.shape)
+
+    def test_linear_transpose_and_stats_mapping(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=2)
+        variables, _ = convert_state_dict(sd, small_cfg, example)
+        assert np.array_equal(
+            sd["node_in_embedding.weight"].T,
+            variables["params"]["node_in_embedding"]["Dense_0"]["kernel"],
+        )
+        assert np.array_equal(
+            sd["gnn_module.0.init_edge_module.node_embedding.weight"],
+            variables["params"]["gnn"]["init_edge_module"]["node_embedding"]["embedding"],
+        )
+        assert np.array_equal(
+            sd["gnn_module.0.gt_block.0.batch_norm1_node_feats.running_var"],
+            variables["batch_stats"]["gnn"]["gt_layer_0"]["norm1_node"][
+                "MaskedBatchNorm_0"]["var"],
+        )
+        conv = sd["interact_module.phase2_resnet.resnet_bin_resnet_extra1_conv2d_2.weight"]
+        assert np.array_equal(
+            np.transpose(conv, (2, 3, 1, 0)),
+            variables["params"]["decoder"]["phase2_resnet"]["extra_block_1"][
+                "conv2d_2"]["kernel"],
+        )
+
+    def test_final_layer_maps_to_last_gt_block_index(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=3)
+        variables, _ = convert_state_dict(sd, small_cfg, example)
+        assert np.array_equal(
+            sd["gnn_module.0.gt_block.1.mha_module.Q.weight"].T,
+            variables["params"]["gnn"]["final_gt_layer"]["mha"]["Q"]["Dense_0"]["kernel"],
+        )
+
+    def test_shared_norm_alias_mismatch_rejected(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=4)
+        key = ("gnn_module.0.gt_block.0.conformation_module.pre_res_blocks.0."
+               "res_block.4.weight")
+        sd[key] = sd[key] + 1.0
+        with pytest.raises(ValueError, match="shared-norm alias"):
+            convert_state_dict(sd, small_cfg, example)
+
+    def test_unknown_key_rejected_strict(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=5)
+        sd["mystery.weight"] = np.zeros(3, np.float32)
+        with pytest.raises(KeyError, match="not mapped"):
+            convert_state_dict(sd, small_cfg, example)
+
+    def test_missing_key_rejected_strict(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=6)
+        del sd["interact_module.phase2_conv.bias"]
+        with pytest.raises(KeyError, match="absent"):
+            convert_state_dict(sd, small_cfg, example)
+
+    def test_shape_mismatch_rejected(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=7)
+        sd["node_in_embedding.weight"] = np.zeros((4, 4), np.float32)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            convert_state_dict(sd, small_cfg, example)
+
+    @pytest.mark.slow
+    def test_imported_model_runs_forward(self, small_cfg, example):
+        sd = synthesize_reference_state_dict(small_cfg, example, seed=8)
+        variables, _ = convert_state_dict(sd, small_cfg, example)
+        model = DeepInteract(small_cfg)
+        logits = model.apply(
+            {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+            example.graph1, example.graph2, train=False,
+        )
+        assert np.all(np.isfinite(np.asarray(logits)))
+
+
+# ---------------------------------------------------------------------------
+# Executed parity against the reference's own torch modules
+# ---------------------------------------------------------------------------
+
+
+def _import_reference_modules():
+    """Import ``project.utils.deepinteract_modules`` from /root/reference
+    with its DGL/Lightning/metrics dependencies stubbed (the decoder and
+    ResBlock classes under test are pure torch)."""
+    if "project.utils.deepinteract_modules" in sys.modules:
+        return sys.modules["project.utils.deepinteract_modules"]
+
+    def stub(name, **attrs):
+        mod = types.ModuleType(name)
+        for k, v in attrs.items():
+            setattr(mod, k, v)
+        sys.modules[name] = mod
+        return mod
+
+    import torch.nn as tnn
+
+    dgl = stub("dgl", DGLGraph=object)
+    dgl.function = stub("dgl.function")
+    # dgl.udf.EdgeBatch/NodeBatch appear in UDF type annotations, which
+    # torch class bodies evaluate at import time.
+    dgl.udf = stub("dgl.udf", EdgeBatch=object, NodeBatch=object)
+    dgl.nn = stub("dgl.nn")
+    dgl.nn.pytorch = stub("dgl.nn.pytorch", GraphConv=tnn.Identity)
+    stub("pytorch_lightning", LightningModule=tnn.Module,
+         seed_everything=lambda *a, **k: None)
+    stub("torchmetrics", **{
+        n: (lambda *a, **k: tnn.Identity())
+        for n in ("Accuracy", "Precision", "Recall", "AUROC",
+                  "AveragePrecision", "F1Score")
+    })
+    stub("wandb")
+
+    class _Dummy:
+        def __init__(self, *a, **k):
+            pass
+
+    bio = stub("Bio")
+    bio.PDB = stub("Bio.PDB")
+    stub("Bio.PDB.PDBParser", PDBParser=_Dummy)
+    stub("Bio.PDB.Polypeptide", CaPPBuilder=_Dummy)
+
+    noop = lambda *a, **k: None  # noqa: E731
+    stub(
+        "project.utils.deepinteract_utils",
+        construct_interact_tensor=noop, glorot_orthogonal=noop,
+        get_geo_feats_from_edges=noop,
+        construct_subsequenced_interact_tensors=noop,
+        insert_interact_tensor_logits=noop, remove_padding=noop,
+        remove_subsequenced_input_padding=noop, calculate_top_k_prec=noop,
+        calculate_top_k_recall=noop, extract_object=noop,
+    )
+    stub("project.utils.graph_utils", src_dot_dst=noop, scaling=noop,
+         imp_exp_attn=noop, out_edge_features=noop, exp=noop)
+    stub("project.utils.vision_modules", DeepLabV3Plus=object)
+
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)
+    import importlib
+
+    return importlib.import_module("project.utils.deepinteract_modules")
+
+
+needs_reference = pytest.mark.skipif(
+    not HAVE_REFERENCE, reason="/root/reference not present")
+
+
+def test_import_cli_end_to_end(tmp_path, small_cfg, example):
+    """cli.import_checkpoint on a Lightning-shaped .ckpt (state_dict +
+    hyper_parameters) -> orbax dir restorable by the Checkpointer the way
+    cli.test/predict do (lit_model_test.py:121-130 analog)."""
+    sd = synthesize_reference_state_dict(small_cfg, example, seed=11)
+    ckpt_file = tmp_path / "ref.ckpt"
+    torch.save(
+        {
+            "state_dict": {k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
+            "hyper_parameters": {"num_gnn_layers": 2, "num_interact_layers": 2,
+                                 "gnn_layer_type": "geotran",
+                                 "interact_module_type": "dil_resnet"},
+        },
+        str(ckpt_file),
+    )
+    out_dir = tmp_path / "imported"
+    from deepinteract_tpu.cli.import_checkpoint import main
+
+    assert main(["--ckpt", str(ckpt_file), "--out_dir", str(out_dir)]) == 0
+
+    from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig
+    from deepinteract_tpu.training.import_torch import abstract_variables
+
+    abstract = abstract_variables(small_cfg, example)
+    import jax
+
+    target = {
+        "params": jax.tree_util.tree_map(
+            lambda l: np.zeros(l.shape, np.float32), dict(abstract)["params"]),
+        "batch_stats": jax.tree_util.tree_map(
+            lambda l: np.zeros(l.shape, np.float32), dict(abstract)["batch_stats"]),
+    }
+    ckpt = Checkpointer(CheckpointConfig(directory=str(out_dir), keep_last=False))
+    restored = ckpt.restore(target, which="best", partial=True)
+    ckpt.close()
+    assert np.array_equal(
+        restored["params"]["node_in_embedding"]["Dense_0"]["kernel"],
+        sd["node_in_embedding.weight"].T,
+    )
+
+
+@needs_reference
+@pytest.mark.slow
+def test_reference_decoder_executed_parity():
+    """Reference ResNet2DInputWithOptAttention vs our InteractionDecoder,
+    weights imported through the converter: logits must agree to 1e-4.
+
+    This is the strongest offline substitute for loading the published
+    Zenodo checkpoint: the decoder is ~60% of the model's parameters, and
+    the GT-side mapping is covered by the round-trip suite above plus the
+    ResBlock executed parity below."""
+    mods = _import_reference_modules()
+    torch.manual_seed(0)
+    # Small-but-structurally-complete config: 2 chunks exercise the i/d
+    # naming grid; odd 24x17 spatial size guards against any layout slips.
+    ref = mods.ResNet2DInputWithOptAttention(
+        num_chunks=2, init_channels=64, num_channels=32, num_classes=2,
+        module_name="interaction",
+    )
+    ref.eval()
+    x = torch.randn(1, 64, 24, 17)
+    with torch.no_grad():
+        ref_logits = ref(x).numpy()  # [1, 2, 24, 17]
+
+    sd = {f"interact_module.{k}": v.numpy() for k, v in ref.state_dict().items()}
+
+    import dataclasses
+
+    import jax
+
+    from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
+    from deepinteract_tpu.training.import_torch import (
+        _iter_leaf_paths,
+        _set_leaf,
+    )
+
+    cfg = DecoderConfig(num_chunks=2, in_channels=64, num_channels=32)
+    dec = InteractionDecoder(cfg)
+    x_nhwc = np.transpose(x.numpy(), (0, 2, 3, 1))
+    abstract = jax.eval_shape(
+        lambda: dec.init(jax.random.PRNGKey(0), x_nhwc, None, train=False))
+    params: dict = {}
+    consumed = set()
+    for path, leaf in _iter_leaf_paths(dict(abstract)["params"]):
+        rule = map_flax_path("params", ("decoder",) + path, num_layers=2)
+        value = rule.transform(sd[rule.ref_key])
+        assert tuple(value.shape) == tuple(leaf.shape), (path, value.shape, leaf.shape)
+        _set_leaf(params, path, value)
+        consumed.add(rule.ref_key)
+    assert consumed == set(sd), sorted(set(sd) - consumed)[:5]
+
+    ours = dec.apply({"params": params}, x_nhwc, None, train=False)
+    ours_nchw = np.transpose(np.asarray(ours), (0, 3, 1, 2))
+    np.testing.assert_allclose(ours_nchw, ref_logits, rtol=1e-4, atol=1e-4)
+
+
+@needs_reference
+def test_reference_resblock_executed_parity():
+    """Reference conformation ResBlock (shared BatchNorm1d at three
+    positions, deepinteract_modules.py:455-497) vs our ResBlock in eval
+    mode with imported weights and running stats."""
+    mods = _import_reference_modules()
+    torch.manual_seed(1)
+    ref = mods.ResBlock(hidden_channels=16)
+    # give the shared norm nontrivial running statistics
+    norm = ref.res_block[1]
+    assert norm is ref.res_block[4] and norm is ref.res_block[7]
+    with torch.no_grad():
+        norm.running_mean.normal_()
+        norm.running_var.uniform_(0.5, 2.0)
+    ref.eval()
+    x = torch.randn(5, 16)
+    with torch.no_grad():
+        ref_out = ref(x).numpy()
+
+    import jax
+
+    from deepinteract_tpu.models.layers import ResBlock as OurResBlock
+    from deepinteract_tpu.training.import_torch import _iter_leaf_paths, _set_leaf
+
+    sd = {f"pre.pre_res_blocks.0.{k}": v.numpy() for k, v in ref.state_dict().items()}
+    block = OurResBlock(16, "batch")
+    mask = np.ones((5,), bool)
+    abstract = jax.eval_shape(
+        lambda: block.init(jax.random.PRNGKey(0), x.numpy(), mask, False))
+    variables: dict = {}
+    for col in ("params", "batch_stats"):
+        for path, leaf in _iter_leaf_paths(dict(abstract)[col]):
+            from deepinteract_tpu.training.import_torch import _map_resblock
+
+            rule = _map_resblock("pre", ("pre_res_block_0",) + path, col)
+            _set_leaf(variables, (col,) + path, rule.transform(sd[rule.ref_key]))
+    ours = block.apply(variables, x.numpy(), mask, False)
+    np.testing.assert_allclose(np.asarray(ours), ref_out, rtol=1e-5, atol=1e-5)
