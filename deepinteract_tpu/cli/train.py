@@ -21,7 +21,31 @@ from deepinteract_tpu.cli.args import (
 
 
 def main(argv=None) -> int:
-    args = build_parser(__doc__).parse_args(argv)
+    parser = build_parser(__doc__)
+    g = parser.add_argument_group("distributed")
+    g.add_argument("--coordinator_address", type=str, default=None,
+                   help="host:port of process 0 (multi-host training; the "
+                        "reference's --num_compute_nodes analog, "
+                        "lit_model_train.py:217,226)")
+    g.add_argument("--num_processes", type=int, default=None)
+    g.add_argument("--process_id", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    # Must run before anything touches the XLA backend (parallel/multihost
+    # .py docstring); on TPU pods everything auto-detects, on CPU/GPU the
+    # three flags (or JAX_COORDINATOR_ADDRESS etc.) select the topology.
+    from deepinteract_tpu.parallel.multihost import (
+        initialize_distributed,
+        is_primary_host,
+    )
+
+    initialize_distributed(
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+
+    import jax
 
     from deepinteract_tpu.data.datasets import PICPDataModule
     from deepinteract_tpu.data.loader import BucketedLoader
@@ -41,10 +65,25 @@ def main(argv=None) -> int:
         split_ver=args.split_ver,
         seed=args.seed,
     )
+    # Multi-host: hosts plan identical GLOBAL batches and load disjoint
+    # per-host slices of each (BucketedLoader shard) — the
+    # DistributedSampler analog that also keeps bucket shapes and step
+    # counts aligned across hosts (a raw file-list split would not).
+    # Val/test stay unsharded: every host evaluates the same complexes,
+    # keeping the sharded eval collectives aligned and the metrics
+    # identical on all hosts.
+    shard = (
+        (jax.process_index(), jax.process_count())
+        if jax.process_count() > 1 else None
+    )
     train_loader = BucketedLoader(
         dm.train, batch_size=args.batch_size, shuffle=True, drop_remainder=True,
-        seed=args.seed, pad_to_max_bucket=args.pad_to_max_bucket,
+        seed=args.seed, pad_to_max_bucket=args.pad_to_max_bucket, shard=shard,
     )
+    if shard:
+        print(f"host {shard[0]}/{shard[1]}: {train_loader.num_batches()} "
+              f"coordinated global steps/epoch, {args.batch_size} local x "
+              f"{shard[1]} hosts per step")
     val_loader = BucketedLoader(dm.val, batch_size=1)
     test_loader = BucketedLoader(dm.test, batch_size=1)
 
@@ -72,8 +111,14 @@ def main(argv=None) -> int:
         optim_cfg = dataclasses.replace(optim_cfg, lr=suggested)
 
     mesh = make_mesh_from_args(args)
+    if mesh is None and jax.process_count() > 1:
+        # Multi-host requires the GSPMD path; span every global device.
+        from deepinteract_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(num_pair=args.num_pair_shards)
+        print(f"multi-host: auto mesh over {len(jax.devices())} devices")
     trainer = Trainer(model, loop_cfg, optim_cfg, mesh=mesh,
-                      metric_writer=make_metric_writer(args))
+                      metric_writer=make_metric_writer(args) if is_primary_host() else None)
 
     example = next(iter(train_loader))
     state = trainer.init_state(
@@ -93,9 +138,10 @@ def main(argv=None) -> int:
 
     test_metrics = trainer.evaluate(
         state, test_loader, stage="test", targets=test_loader.targets(),
-        csv_path="test_top_metrics.csv",
+        csv_path="test_top_metrics.csv" if is_primary_host() else None,
     )
-    print({k: round(v, 4) for k, v in test_metrics.items()})
+    if is_primary_host():
+        print({k: round(v, 4) for k, v in test_metrics.items()})
     return 0
 
 

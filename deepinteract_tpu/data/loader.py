@@ -45,6 +45,7 @@ class BucketedLoader:
         seed: int = 42,
         pad_to_max_bucket: bool = False,
         prefetch: int = 2,
+        shard: Optional[Tuple[int, int]] = None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -55,6 +56,16 @@ class BucketedLoader:
         # Batches ready ahead of the consumer on a background thread
         # (npz load + pad + stack overlap device compute; 0 disables).
         self.prefetch = prefetch
+        # (host_index, host_count): coordinated multi-host sharding. Every
+        # host plans GLOBAL batches of batch_size*host_count over the FULL
+        # dataset with identical seeds, then loads only its own
+        # batch_size-slice of each — so step counts and bucket shapes agree
+        # across hosts by construction (a per-host split of the *file list*
+        # would give hosts different bucket distributions and deadlock the
+        # global collectives on the first divergent batch shape).
+        self.shard = shard
+        if shard is not None:
+            assert 0 <= shard[0] < shard[1], shard
         # Bucket planning reads every header once, up front.
         self._buckets = self._plan()
 
@@ -72,33 +83,54 @@ class BucketedLoader:
             buckets[self._item_bucket(n1, n2)].append(idx)
         return dict(buckets)
 
+    def _global_batch_size(self) -> int:
+        return self.batch_size * (self.shard[1] if self.shard else 1)
+
     def num_batches(self) -> int:
+        gb = self._global_batch_size()
         total = 0
         for indices in self._buckets.values():
             if self.drop_remainder:
-                total += len(indices) // self.batch_size
+                total += len(indices) // gb
             else:
-                total += (len(indices) + self.batch_size - 1) // self.batch_size
+                total += (len(indices) + gb - 1) // gb
         return total
 
     def _epoch_plan(self, epoch: int) -> List[Tuple[Tuple[int, int], List[int]]]:
+        """Global plan: identical on every host (seeded shuffles only)."""
+        gb = self._global_batch_size()
         plan = []
         rng = random.Random(self.seed + epoch) if self.shuffle else None
         for bucket, indices in sorted(self._buckets.items()):
             idxs = list(indices)
             if rng:
                 rng.shuffle(idxs)
-            for i in range(0, len(idxs), self.batch_size):
-                chunk = idxs[i : i + self.batch_size]
-                if len(chunk) < self.batch_size and self.drop_remainder:
-                    continue
+            for i in range(0, len(idxs), gb):
+                chunk = idxs[i : i + gb]
+                if len(chunk) < gb:
+                    if self.drop_remainder:
+                        continue
+                    if self.shard:
+                        # Wrap within the bucket (DistributedSampler
+                        # padding) so every host's slice stays full.
+                        k = 0
+                        while len(chunk) < gb:
+                            chunk.append(idxs[k % len(idxs)])
+                            k += 1
                 plan.append((bucket, chunk))
         if rng:
             rng.shuffle(plan)  # interleave buckets across the epoch
         return plan
 
+    def _host_slice(self, chunk: List[int]) -> List[int]:
+        if self.shard is None:
+            return chunk
+        start = self.shard[0] * self.batch_size
+        return chunk[start : start + self.batch_size]
+
     def _produce(self, epoch: int, with_targets: bool) -> Iterator:
         for (b1, b2), chunk in self._epoch_plan(epoch):
+            chunk = self._host_slice(chunk)
             complexes, targets = [], []
             for idx in chunk:
                 raw = self.dataset[idx]
@@ -122,7 +154,7 @@ class BucketedLoader:
         """Target names in epoch-0 iteration order (for eval CSV export)."""
         out = []
         for _, chunk in self._epoch_plan(0):
-            out.extend(self.dataset.target_of(i) for i in chunk)
+            out.extend(self.dataset.target_of(i) for i in self._host_slice(chunk))
         return out
 
     def __call__(self, epoch: int) -> Iterator[PairedComplex]:

@@ -240,8 +240,11 @@ class DeepLabDecoder(nn.Module):
         y = ASPP(cfg)(deep, m16, train)
 
         # Upsample x4, fuse with the 1x1-projected high-res skip, refine.
-        y = jax.image.resize(y, (b, skip.shape[1], skip.shape[2], y.shape[-1]),
-                             method="bilinear")
+        # Mask-renormalized bilinear (resize y*mask and mask separately,
+        # then divide): plain bilinear would pull masked-out zeros into
+        # valid cells near the pad frontier, making logits depend on the
+        # padding bucket — the unpadded reference has no such frontier.
+        y = _masked_resize(y, m16, (skip.shape[1], skip.shape[2]))
         hi = ConvNormAct(cfg.high_res_channels, 1)(skip, m4)
         y = jnp.concatenate([y * m4[..., None], hi], axis=-1)
         y = SeparableConv(cfg.decoder_channels)(y, m4)
@@ -252,11 +255,20 @@ class DeepLabDecoder(nn.Module):
             cfg.num_classes, (1, 1),
             bias_init=_pos_bias_init(cfg.num_classes),
         )(y)
-        logits = jax.image.resize(
-            logits, (b, x.shape[1], x.shape[2], cfg.num_classes), method="bilinear"
-        )
+        logits = _masked_resize(logits, m4, (x.shape[1], x.shape[2]))
         logits = logits[:, :h, :w, :]
         return logits * mask[:, :h, :w, None]
+
+
+def _masked_resize(y: jnp.ndarray, mask: jnp.ndarray, hw) -> jnp.ndarray:
+    """Bilinear upsample that ignores padded cells: resize the masked
+    values and the mask, then renormalize by the resized mask (zero where
+    no valid support). Padded buckets thus reproduce unpadded outputs."""
+    b, _, _, c = y.shape
+    m = mask[..., None].astype(y.dtype)
+    num = jax.image.resize(y * m, (b, hw[0], hw[1], c), method="bilinear")
+    den = jax.image.resize(m, (b, hw[0], hw[1], 1), method="bilinear")
+    return jnp.where(den > 1e-6, num / jnp.maximum(den, 1e-6), 0.0)
 
 
 def _pos_bias_init(num_classes: int):

@@ -42,11 +42,37 @@ def make_mesh(
 def shard_batch(batch, mesh: Mesh):
     """Place a stacked batch pytree with its leading axis split over
     ``data`` (the per-host sharded-file-list analog of Lightning's
-    DistributedSampler)."""
+    DistributedSampler).
+
+    Single-process: a plain sharded ``device_put``. Multi-process (mesh
+    spans hosts): each host contributes its *local* batch as its shard of
+    the global array (``jax.make_array_from_process_local_data``) — the
+    global batch is the concatenation over hosts, so a per-host
+    local batch of B complexes trains a global batch of
+    ``B * process_count`` exactly like DDP."""
     sharding = NamedSharding(mesh, P(DATA_AXIS))
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            batch,
+        )
     return jax.device_put(batch, sharding)
 
 
 def replicate(tree, mesh: Mesh):
-    """Fully replicate a pytree (params/opt state) across the mesh."""
-    return jax.device_put(tree, NamedSharding(mesh, P()))
+    """Fully replicate a pytree (params/opt state) across the mesh.
+
+    Multi-process meshes build the global replicated array from each
+    host's (identical, same-seed) local copy; the global shape equals the
+    local shape since nothing is partitioned."""
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x), np.shape(x)
+            ),
+            tree,
+        )
+    return jax.device_put(tree, sharding)

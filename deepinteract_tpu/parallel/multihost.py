@@ -22,6 +22,7 @@ to process_count() == 1.
 
 from __future__ import annotations
 
+import itertools
 from typing import Optional, Sequence
 
 import jax
@@ -43,14 +44,33 @@ def initialize_distributed(
     ``jax.process_count()`` initializes it, after which distributed init
     is rejected) — call it first thing in the training entry point.
     """
-    # Idempotency via the distributed client itself: process_count() would
-    # initialize the XLA backend and make a later initialize() impossible.
-    state = getattr(jax.distributed, "global_state", None)
-    if state is not None and getattr(state, "client", None) is not None:
-        return jax.process_index()  # already initialized
+    import os
+
+    from jax._src import distributed as _dist
+
     explicit = any(
         v is not None for v in (coordinator_address, num_processes, process_id)
     )
+    # Idempotency via the distributed client itself: process_count() would
+    # initialize the XLA backend and make a later initialize() impossible.
+    if getattr(_dist.global_state, "client", None) is not None:
+        return jax.process_index()  # already initialized
+    if explicit or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        # A deliberate multi-process run. CPU backends need a collectives
+        # implementation AND the platform pinned through jax.config (the
+        # env var alone does not stop a registered accelerator PJRT plugin
+        # from claiming the default backend, and a backend built before
+        # the distributed client exists is permanently single-process).
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if _xb._backends:
+                _xb._clear_backends()
+        except Exception:  # pragma: no cover - internal API best effort
+            pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -71,21 +91,78 @@ def shard_filenames_for_host(
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
 ) -> list:
-    """This host's contiguous shard of the (already shuffled) complex list
-    — the DistributedSampler analog. Every host must receive the same
-    ``filenames`` ordering (same seed) for shards to be disjoint."""
+    """This host's shard of the (already shuffled) complex list — the
+    DistributedSampler analog. Every host must receive the same
+    ``filenames`` ordering (same seed) for shards to be disjoint.
+
+    torch DistributedSampler semantics: when ``len(filenames)`` is not a
+    multiple of the host count, the list is padded by wrapping around to
+    the front, so every complex is seen each epoch (a few appear twice)
+    and every host runs the same number of steps — a straggler host would
+    deadlock collectives at epoch end."""
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
     if pc <= 1:
         return list(filenames)
-    # Drop the remainder so every host runs the same number of steps (a
-    # straggler host would deadlock collectives at epoch end).
-    per_host = len(filenames) // pc
+    names = list(filenames)
+    per_host = -(-len(names) // pc)  # ceil
+    padded = list(itertools.islice(itertools.cycle(names), per_host * pc))
     start = pi * per_host
-    return list(filenames[start : start + per_host])
+    return padded[start : start + per_host]
 
 
 def is_primary_host() -> bool:
     """True on the process that should write checkpoints/logs (rank-0
     semantics of the reference's Lightning callbacks)."""
     return jax.process_index() == 0
+
+
+def host_local_array(x):
+    """A global ``jax.Array`` -> this host's local numpy view.
+
+    * fully-addressable (single-process, or host-local) arrays: as-is;
+    * replicated multi-host arrays (losses, params): the first local
+      shard, which holds the full value;
+    * batch-sharded multi-host arrays (eval outputs): local shards
+      reassembled — concatenated along axis 0 (the complexes THIS host fed
+      in) and, when a second mesh axis (e.g. 'pair') partitions axis 1,
+      along axis 1 as well. Distinct-index duplicates from replicating
+      axes are dropped.
+
+    Raises if the local shards cannot reconstruct full rows (axis 1+
+    partitioned across *hosts*): a silent partial view would corrupt
+    metrics downstream — gather on device before reading instead.
+    """
+    import numpy as np
+
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    shards = {s.index: s for s in x.addressable_shards}  # dedup replicas
+    if len(shards) == 1:
+        return np.asarray(next(iter(shards.values())).data)
+
+    def start(idx, axis):
+        return (idx[axis].start or 0) if x.ndim > axis else 0
+
+    for idx in shards:
+        for axis in range(2, x.ndim):
+            if start(idx, axis) != 0:
+                raise ValueError(
+                    f"host_local_array: axis {axis} is partitioned "
+                    "(only axes 0/1 are reassembled); gather on device first"
+                )
+    rows = {}
+    for idx, s in shards.items():
+        rows.setdefault(start(idx, 0), {})[start(idx, 1)] = np.asarray(s.data)
+    out_rows = []
+    for a0 in sorted(rows):
+        cols = [rows[a0][k] for k in sorted(rows[a0])]
+        row = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+        if x.ndim >= 2 and row.shape[1] != x.shape[1]:
+            raise ValueError(
+                "host_local_array: axis 1 shards on this host do not cover "
+                "the full dimension (pair axis spans hosts); gather on "
+                "device before reading"
+            )
+        out_rows.append(row)
+    return out_rows[0] if len(out_rows) == 1 else np.concatenate(out_rows, axis=0)

@@ -30,15 +30,24 @@ _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
 
 
 def _compile() -> bool:
+    """Compile to a process-unique temp name, then atomically rename into
+    place: concurrent builders (multi-host training, parallel dataset
+    builds on a shared FS) never dlopen a half-written .so."""
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = [
         os.environ.get("CXX", "g++"), "-O3", "-shared", "-fPIC",
-        "-std=c++17", _SRC, "-o", _LIB_PATH,
+        "-std=c++17", _SRC, "-o", tmp_path,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp_path, _LIB_PATH)
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
         return False
 
 
@@ -57,8 +66,17 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
-            _load_failed = True
-            return None
+            # A racing process may have just replaced the file; one rebuild
+            # -and-retry before latching the failure for process lifetime.
+            if _compile():
+                try:
+                    lib = ctypes.CDLL(_LIB_PATH)
+                except OSError:
+                    _load_failed = True
+                    return None
+            else:
+                _load_failed = True
+                return None
         lib.sasa_and_depth.argtypes = [
             _f32p, _f32p, ctypes.c_int, ctypes.c_int, ctypes.c_float, _f32p, _f32p,
         ]

@@ -65,6 +65,22 @@ class Chain:
     def sequence(self) -> str:
         return "".join(constants.D3TO1.get(r, "-") for r in self.resnames)
 
+    def slice_residues(self, start: int, stop: int) -> "Chain":
+        """Contiguous residue window [start, stop) as a new Chain (atom
+        arrays re-based). Used to derive fragment complexes from real
+        structures (real-geometry multi-complex datasets, tools/
+        real_data_proof.py) and for windowed analyses."""
+        a0, a1 = int(self.atom_start[start]), int(self.atom_start[stop])
+        return Chain(
+            chain_id=self.chain_id,
+            resnames=self.resnames[start:stop],
+            res_ids=self.res_ids[start:stop],
+            atom_start=np.asarray(self.atom_start[start : stop + 1]) - a0,
+            atom_names=self.atom_names[a0:a1],
+            coords=self.coords[a0:a1],
+            elements=self.elements[a0:a1],
+        )
+
     def backbone(self) -> np.ndarray:
         """[R, 4, 3] N/CA/C/O coordinates.
 
@@ -233,3 +249,35 @@ def merge_chains(chains: Sequence[Chain], chain_id: str = "M") -> Chain:
         coords=np.concatenate(coords_list, axis=0) if coords_list else np.zeros((0, 3), np.float32),
         elements=elements,
     )
+
+
+def write_pdb(chain: Chain, path: str) -> None:
+    """Minimal PDB writer (ATOM records only) — the inverse of
+    :func:`parse_pdb_chains` for single chains. Lets tools materialize
+    derived structures (e.g. residue-window fragments) as files the
+    builder CLI can re-ingest."""
+    cid = (chain.chain_id or "A")[0]
+    with open(path, "w") as fh:
+        serial = 1
+        for i, resname in enumerate(chain.resnames):
+            res_id = chain.res_ids[i].split(":")[-1]
+            try:
+                res_seq = int("".join(c for c in res_id if c.isdigit() or c == "-"))
+            except ValueError:
+                res_seq = i + 1
+            icode = res_id[-1] if res_id and res_id[-1].isalpha() else " "
+            s = chain.residue_atoms(i)
+            for a in range(s.start, s.stop):
+                name = chain.atom_names[a]
+                # PDB column rules: 4-char names start at col 13, shorter
+                # element-leading names at col 14.
+                name_field = name.ljust(4) if len(name) == 4 else f" {name:<3}"
+                x, y, z = chain.coords[a]
+                fh.write(
+                    f"ATOM  {serial:5d} {name_field} {resname:<3s} {cid}"
+                    f"{res_seq:4d}{icode}   {x:8.3f}{y:8.3f}{z:8.3f}"
+                    f"{1.00:6.2f}{0.00:6.2f}          "
+                    f"{chain.elements[a]:>2s}\n"
+                )
+                serial += 1
+        fh.write("TER\nEND\n")

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import shutil
 import subprocess
 import tempfile
 from typing import Optional
@@ -75,11 +76,19 @@ def sequence_profile(sequence: str) -> np.ndarray:
     bin_path = os.environ.get("DI_HHBLITS_BIN")
     db_path = os.environ.get("DI_HHBLITS_DB")
     n = len(sequence)
-    if bin_path and db_path and os.path.exists(bin_path):
+    # shutil.which resolves bare command names via PATH *and* validates
+    # executability of absolute paths, so DI_HHBLITS_BIN=hhblits works.
+    resolved = shutil.which(bin_path) if bin_path else None
+    if resolved and db_path:
         try:
-            return _run_hhblits(sequence, bin_path, db_path)
+            return _run_hhblits(sequence, resolved, db_path)
         except Exception as exc:  # pragma: no cover - needs external DB
             logger.warning("hhblits failed (%s); sequence profile set to zeros", exc)
+    elif bin_path and not resolved:
+        logger.warning(
+            "DI_HHBLITS_BIN=%s is not an executable on PATH; 27-d "
+            "sequence-profile features set to zeros", bin_path
+        )
     else:
         logger.warning(
             "no hhblits binary/database configured (DI_HHBLITS_BIN/DI_HHBLITS_DB); "
@@ -88,7 +97,7 @@ def sequence_profile(sequence: str) -> np.ndarray:
     return np.zeros((n, constants.NUM_SEQUENCE_FEATS), dtype=np.float32)
 
 
-def _run_hhblits(sequence: str, bin_path: str, db_path: str) -> np.ndarray:  # pragma: no cover
+def _run_hhblits(sequence: str, bin_path: str, db_path: str) -> np.ndarray:
     with tempfile.TemporaryDirectory() as tmp:
         fasta = os.path.join(tmp, "query.fasta")
         hhm = os.path.join(tmp, "query.hhm")
@@ -101,9 +110,15 @@ def _run_hhblits(sequence: str, bin_path: str, db_path: str) -> np.ndarray:  # p
         return parse_hhm(hhm, len(sequence))
 
 
-def parse_hhm(path: str, n_residues: int) -> np.ndarray:  # pragma: no cover
+def parse_hhm(path: str, n_residues: int) -> np.ndarray:
     """Parse an hhblits .hhm profile into [R, 27] probabilities
-    (atom3.conservation convention: p = 2^(-v/1000), '*' -> 0)."""
+    (atom3.conservation convention: p = 2^(-v/1000), '*' -> 0).
+
+    Layout handled (hh-suite3 hhm format): header ends at the ``HMM``
+    column-name line, followed by the transition-name line and the null
+    transition row; then one 3-line record per residue — emission line
+    ``<aa> <idx> <20 scores> <idx>``, transition line ``<7 scores> <3
+    Neff>``, blank separator — terminated by ``//``."""
     out = np.zeros((n_residues, constants.NUM_SEQUENCE_FEATS), dtype=np.float32)
 
     def decode(tok: str) -> float:

@@ -46,17 +46,36 @@ class Checkpointer:
             # val_ce would score +inf under best_mode='max'.
             return sign * v if not math.isnan(v) else -math.inf
 
+        # Multi-host runs checkpoint from the primary host only (the state
+        # tree is replicated and already materialized as host-local numpy,
+        # training/loop.py state_to_tree); restricting orbax's active
+        # process set keeps its internal barriers from waiting on hosts
+        # that never construct a Checkpointer.
+        import jax
+
+        mp_kwargs = {}
         root = os.path.abspath(cfg.directory)
+        if jax.process_count() > 1:
+            mp_kwargs["multiprocessing_options"] = ocp.options.MultiprocessingOptions(
+                primary_host=jax.process_index(),
+                active_processes={jax.process_index()},
+            )
+            # orbax refuses create=True under active_processes; make the
+            # roots ourselves (this manager is single-process by design).
+            mp_kwargs["create"] = False
+            for sub in ("best", "last") if cfg.keep_last else ("best",):
+                os.makedirs(os.path.join(root, sub), exist_ok=True)
         self.best = ocp.CheckpointManager(
             os.path.join(root, "best"),
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=cfg.save_top_k, best_fn=best_fn, best_mode="max"
+                max_to_keep=cfg.save_top_k, best_fn=best_fn, best_mode="max",
+                **mp_kwargs,
             ),
         )
         self.last = (
             ocp.CheckpointManager(
                 os.path.join(root, "last"),
-                options=ocp.CheckpointManagerOptions(max_to_keep=1),
+                options=ocp.CheckpointManagerOptions(max_to_keep=1, **mp_kwargs),
             )
             if cfg.keep_last
             else None

@@ -31,6 +31,7 @@ import numpy as np
 
 from deepinteract_tpu.data.graph import PairedComplex
 from deepinteract_tpu.models.model import DeepInteract
+from deepinteract_tpu.parallel.multihost import host_local_array, is_primary_host
 from deepinteract_tpu.training import metrics as M
 from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig, metric_mode
 from deepinteract_tpu.training.optim import OptimConfig
@@ -202,18 +203,22 @@ class Trainer:
         per_complex: List[Dict[str, float]] = []
         used_targets: List[str] = []
         idx = 0
-        for batch in _iter_data(val_data, 0):
-            batch = self._device_batch(batch)
+        for host_batch in _iter_data(val_data, 0):
+            batch = self._device_batch(host_batch)
             out = self._eval_step(state, batch)
-            probs = np.asarray(out["probs"])
+            # Multi-host: every host feeds the same complexes, so this
+            # host's local shard of the global outputs is exactly what
+            # host_batch holds — metrics come out identical on all hosts.
+            probs = host_local_array(out["probs"])
+            logits = host_local_array(out["logits"])
             bsz = probs.shape[0]
             for b in range(bsz):
-                n1 = int(np.asarray(batch.graph1.num_nodes)[b])
-                n2 = int(np.asarray(batch.graph2.num_nodes)[b])
-                examples = np.asarray(batch.examples)[b]
-                mask = np.asarray(batch.example_mask)[b]
+                n1 = int(np.asarray(host_batch.graph1.num_nodes)[b])
+                n2 = int(np.asarray(host_batch.graph2.num_nodes)[b])
+                examples = np.asarray(host_batch.examples)[b]
+                mask = np.asarray(host_batch.example_mask)[b]
                 pos_probs, labels = M.gather_pair_predictions(probs[b], examples, mask)
-                ce = _complex_ce(np.asarray(out["logits"])[b], examples, mask)
+                ce = _complex_ce(logits[b], examples, mask)
                 per_complex.append(
                     M.complex_metrics(
                         pos_probs, labels, n1, n2, stage=stage,
@@ -242,19 +247,38 @@ class Trainer:
         """Run the epoch loop. Returns (state, history: list of per-epoch
         metric dicts)."""
         cfg = self.cfg
+        # Rank-0 checkpoint semantics (Lightning callbacks run on rank 0;
+        # our state is fully replicated, so the primary host's numpy copy
+        # is the complete checkpoint).
         ckpt = Checkpointer(
             CheckpointConfig(
                 directory=cfg.ckpt_dir,
                 metric_to_track=cfg.metric_to_track,
                 save_top_k=cfg.save_top_k,
             )
-        ) if cfg.ckpt_dir else None
+        ) if (cfg.ckpt_dir and is_primary_host()) else None
 
         start_epoch = 0
-        if resume and ckpt is not None and ckpt.latest_step() is not None:
-            state = _restore_into(state, ckpt.restore(state_to_tree(state), which="last"))
-            start_epoch = int(ckpt.latest_step())
-            self.log(f"resumed from epoch {start_epoch}")
+        if resume:
+            if ckpt is not None and ckpt.latest_step() is not None:
+                state = _restore_into(
+                    state, ckpt.restore(state_to_tree(state), which="last"))
+                start_epoch = int(ckpt.latest_step())
+                self.log(f"resumed from epoch {start_epoch}")
+            if jax.process_count() > 1:
+                # Only the primary host holds the Checkpointer; every other
+                # host must receive the restored state and epoch, or the
+                # hosts would train different weights over different epoch
+                # ranges (split-brain + collective deadlock at the end).
+                from jax.experimental import multihost_utils
+
+                start_epoch, tree = multihost_utils.broadcast_one_to_all(
+                    (np.asarray(start_epoch), state_to_tree(state))
+                )
+                start_epoch = int(start_epoch)
+                if start_epoch > 0:
+                    state = _restore_into(
+                        state, jax.tree_util.tree_map(np.asarray, tree))
 
         stopper = EarlyStopping(
             metric_mode(cfg.metric_to_track), cfg.patience, cfg.min_delta
@@ -281,9 +305,13 @@ class Trainer:
                 epoch_metrics.update(self.evaluate(state, val_data, stage="val"))
                 if (
                     cfg.viz_every_n_epochs
-                    and self.metric_writer is not None
                     and (epoch + 1) % cfg.viz_every_n_epochs == 0
+                    and (self.metric_writer is not None
+                         or jax.process_count() > 1)
                 ):
+                    # Multi-host: the viz eval step is a global collective,
+                    # so writer-less hosts must still execute it; only the
+                    # image writes are rank-0.
                     self._log_viz_images(state, val_data, epoch)
             history.append(epoch_metrics)
             self._write_metrics(epoch, epoch_metrics)
@@ -297,7 +325,7 @@ class Trainer:
             )
 
             if cfg.swa and epoch >= swa_first_epoch:
-                p = jax.tree_util.tree_map(np.asarray, state.params)
+                p = jax.tree_util.tree_map(host_local_array, state.params)
                 if swa_params is None:
                     swa_params, swa_count = p, 1
                 else:
@@ -355,12 +383,14 @@ class Trainer:
         def log_step(metrics):
             nonlocal step_idx
             step_idx += 1
-            train_losses.append(metrics["loss"])
+            # host_local_array: multi-host losses are replicated global
+            # arrays that plain float() cannot read.
+            train_losses.append(float(host_local_array(metrics["loss"])))
             if cfg.log_every and step_idx % cfg.log_every == 0:
                 self.log(
                     f"epoch {epoch} step {step_idx}: "
-                    f"loss={float(metrics['loss']):.4f} "
-                    f"grad_norm={float(metrics['grad_norm']):.4f}"
+                    f"loss={train_losses[-1]:.4f} "
+                    f"grad_norm={float(host_local_array(metrics['grad_norm'])):.4f}"
                 )
 
         def flush(state):
@@ -433,16 +463,18 @@ class Trainer:
         """Predicted-probability and ground-truth contact maps of the first
         validation complex as TensorBoard images (reference viz epochs,
         deepinteract_modules.py:1850-1881)."""
-        batch = next(iter(_iter_data(val_data, 0)), None)
-        if batch is None:
+        host_batch = next(iter(_iter_data(val_data, 0)), None)
+        if host_batch is None:
             return
-        batch = self._device_batch(batch)
+        batch = self._device_batch(host_batch)
         out = self._eval_step(state, batch)
-        probs = np.asarray(out["probs"])[0, ..., -1]  # [L1, L2] positive class
-        n1 = int(np.asarray(batch.graph1.num_nodes)[0])
-        n2 = int(np.asarray(batch.graph2.num_nodes)[0])
+        if self.metric_writer is None:
+            return  # non-primary host: participated in the collective only
+        probs = host_local_array(out["probs"])[0, ..., -1]  # [L1, L2] positive class
+        n1 = int(np.asarray(host_batch.graph1.num_nodes)[0])
+        n2 = int(np.asarray(host_batch.graph2.num_nodes)[0])
         pred = (probs[:n1, :n2, None] * 255).astype(np.uint8)
-        true = (np.asarray(batch.contact_map)[0, :n1, :n2, None] * 255).astype(np.uint8)
+        true = (np.asarray(host_batch.contact_map)[0, :n1, :n2, None] * 255).astype(np.uint8)
         self.metric_writer.add_image("val_predicted_contact_probs", pred, epoch,
                                      dataformats="HWC")
         self.metric_writer.add_image("val_true_contacts", true, epoch,
@@ -468,9 +500,12 @@ def _complex_ce(logits: np.ndarray, examples: np.ndarray, mask: np.ndarray) -> f
 
 def state_to_tree(state: TrainState):
     """Checkpoint payload: the array-valued fields of the TrainState as a
-    plain dict (orbax-friendly; ``apply_fn``/``tx`` are code, not state)."""
+    plain dict (orbax-friendly; ``apply_fn``/``tx`` are code, not state).
+    Multi-host replicated arrays come back as this host's full local copy
+    (host_local_array), so saving from the primary host needs no
+    cross-process coordination."""
     return jax.tree_util.tree_map(
-        np.asarray,
+        host_local_array,
         {
             "step": state.step,
             "params": state.params,

@@ -186,6 +186,48 @@ def test_bucketed_loader_shapes_and_shuffle(rng):
     assert all(b.graph1.node_feats.shape[0] == 2 for b in strict.iter_epoch(0))
 
 
+def test_bucketed_loader_multihost_shard(rng):
+    """Coordinated multi-host sharding: every host plans the same global
+    batches and loads a disjoint batch_size-slice of each, so step counts
+    and bucket shapes agree across hosts by construction (the per-host
+    alignment the global GSPMD collectives require, cli/train.py)."""
+    raws = [make_raw_complex(n1, n2, rng)
+            for n1, n2 in [(20, 16), (30, 40), (70, 20), (20, 18), (25, 33)]]
+    ds = InMemoryDataset(raws)
+    loaders = [
+        BucketedLoader(ds, batch_size=1, shuffle=True, seed=3,
+                       drop_remainder=True, shard=(pi, 2), prefetch=0)
+        for pi in range(2)
+    ]
+    # Identical global plan => identical step count AND bucket sequence.
+    assert loaders[0].num_batches() == loaders[1].num_batches() == 2
+    shapes = [
+        [(b.graph1.node_feats.shape, b.graph2.node_feats.shape)
+         for b in ld.iter_epoch(0)]
+        for ld in loaders
+    ]
+    assert shapes[0] == shapes[1]
+    # Disjoint complexes within each global step.
+    seen = [
+        [tuple(np.asarray(b.graph1.num_nodes)) for b in ld.iter_epoch(0)]
+        for ld in loaders
+    ]
+    for step0, step1 in zip(*seen):
+        assert step0 != step1
+    # Without drop_remainder the tail wraps (DistributedSampler padding):
+    # both hosts still see full batches in every step.
+    wrap = [
+        BucketedLoader(ds, batch_size=1, seed=3, shard=(pi, 2), prefetch=0)
+        for pi in range(2)
+    ]
+    assert wrap[0].num_batches() == wrap[1].num_batches() == 3
+    for ld in wrap:
+        assert all(b.graph1.node_feats.shape[0] == 1 for b in ld.iter_epoch(0))
+    # Shard targets are per-host views of the same global order.
+    both = set(wrap[0].targets()) | set(wrap[1].targets())
+    assert both == {f"complex_{i}" for i in range(5)}
+
+
 def test_loader_feeds_model_finite_loss(rng):
     """VERDICT done-criterion: converted complex -> model -> finite loss."""
     import jax

@@ -1,0 +1,133 @@
+"""hhblits wrapper coverage: .hhm parsing and the subprocess runtime path.
+
+The reference's most expensive feature is the HH-suite3 sequence profile
+(deepinteract_utils.py:704-718; 27 columns of the node schema). The
+multi-GB database cannot exist in this image, so the runtime path is
+exercised with a fake hhblits executable that emits a known .hhm, and the
+parser against hand-decoded fixture values.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu import constants
+from deepinteract_tpu.pipeline.postprocess import parse_hhm, sequence_profile
+
+# A 3-residue .hhm in the hh-suite3 layout: NULL emission row, HMM
+# column-name line, transition-name line, null transition row, then
+# per-residue (emission, transition, blank) triples, terminated by //.
+FIXTURE_HHM = """\
+HHsearch 1.5
+NAME  query
+LENG  3 match states
+NEFF  1.0
+SEQ
+>query
+ACD
+#
+NULL   3706 5728 4211 4064 4839 3729 4763 4308 4069 3323 5509 4640 4464 4937 4285 4423 3815 3783 6325 4665
+HMM    A\tC\tD\tE\tF\tG\tH\tI\tK\tL\tM\tN\tP\tQ\tR\tS\tT\tV\tW\tY
+       M->M\tM->I\tM->D\tI->M\tI->I\tD->M\tD->D\tNeff\tNeff_I\tNeff_D
+       0\t*\t*\t0\t*\t0\t*\t*\t*\t*
+A 1    0\t1000\t2000\t3000\t4000\t5000\t6000\t7000\t8000\t9000\t10000\t*\t1500\t2500\t3500\t4500\t5500\t6500\t7500\t8500\t1
+       0\t*\t1000\t*\t2000\t*\t3000\t1000\t0\t0
+\x20
+C 2    *\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t*\t2
+       1000\t1000\t1000\t1000\t1000\t1000\t1000\t1000\t0\t0
+\x20
+D 3    500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t500\t3
+       *\t0\t*\t0\t*\t0\t*\t1000\t0\t0
+\x20
+//
+"""
+
+
+def _decode(v):
+    return 0.0 if v == "*" else 2.0 ** (-int(v) / 1000.0)
+
+
+def write_fixture(path: str) -> None:
+    with open(path, "w") as fh:
+        fh.write(FIXTURE_HHM)
+
+
+class TestParseHHM:
+    def test_emission_decoding_and_row_alignment(self, tmp_path):
+        p = tmp_path / "q.hhm"
+        write_fixture(str(p))
+        out = parse_hhm(str(p), 3)
+        assert out.shape == (3, constants.NUM_SEQUENCE_FEATS)
+        # residue 1 emissions: 0, 1000, ..., with '*' at position 11 (N)
+        expected_r0 = [_decode(v) for v in
+                       ["0", "1000", "2000", "3000", "4000", "5000", "6000",
+                        "7000", "8000", "9000", "10000", "*", "1500", "2500",
+                        "3500", "4500", "5500", "6500", "7500", "8500"]]
+        np.testing.assert_allclose(out[0, :20], expected_r0, rtol=1e-6)
+        assert out[0, 0] == 1.0  # 2^0
+        # residue 1 transitions (first 7 columns of its transition line)
+        expected_t0 = [_decode(v) for v in ["0", "*", "1000", "*", "2000", "*", "3000"]]
+        np.testing.assert_allclose(out[0, 20:], expected_t0, rtol=1e-6)
+        # residue 2: all '*' emissions decode to zeros; transitions all 0.5
+        assert np.all(out[1, :20] == 0.0)
+        np.testing.assert_allclose(out[1, 20:], [0.5] * 7, rtol=1e-6)
+        # residue 3 emissions all 2^-0.5
+        np.testing.assert_allclose(out[2, :20], [2 ** -0.5] * 20, rtol=1e-6)
+
+    def test_short_profile_leaves_missing_rows_zero(self, tmp_path):
+        p = tmp_path / "q.hhm"
+        write_fixture(str(p))
+        out = parse_hhm(str(p), 5)  # file has only 3 residue records
+        assert np.any(out[2] != 0)
+        assert np.all(out[3:] == 0.0)
+
+
+class TestSequenceProfileRuntime:
+    @pytest.fixture()
+    def fake_hhblits(self, tmp_path):
+        """An executable that mimics 'hhblits -i x -ohhm out -d db ...' by
+        writing the fixture .hhm to the -ohhm argument."""
+        fixture = tmp_path / "canned.hhm"
+        write_fixture(str(fixture))
+        script = tmp_path / "hhblits"
+        script.write_text(
+            "#!/bin/sh\n"
+            'out=""\n'
+            'while [ $# -gt 0 ]; do\n'
+            '  if [ "$1" = "-ohhm" ]; then out="$2"; shift; fi\n'
+            "  shift\n"
+            "done\n"
+            f'cp "{fixture}" "$out"\n'
+        )
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        return str(script)
+
+    def test_runtime_path_executes_fake_binary(self, fake_hhblits, monkeypatch):
+        monkeypatch.setenv("DI_HHBLITS_BIN", fake_hhblits)
+        monkeypatch.setenv("DI_HHBLITS_DB", "/nonexistent/db")
+        out = sequence_profile("ACD")
+        assert out.shape == (3, constants.NUM_SEQUENCE_FEATS)
+        assert out[0, 0] == 1.0  # the canned profile, not zeros
+        np.testing.assert_allclose(out[1, 20:], [0.5] * 7, rtol=1e-6)
+
+    def test_bare_command_name_resolved_via_path(self, fake_hhblits, monkeypatch):
+        """ADVICE round 2: DI_HHBLITS_BIN=hhblits (bare name) must resolve
+        through PATH instead of silently degrading to zeros."""
+        monkeypatch.setenv("PATH", os.path.dirname(fake_hhblits) + os.pathsep +
+                           os.environ.get("PATH", ""))
+        monkeypatch.setenv("DI_HHBLITS_BIN", "hhblits")
+        monkeypatch.setenv("DI_HHBLITS_DB", "/nonexistent/db")
+        out = sequence_profile("ACD")
+        assert out[0, 0] == 1.0
+
+    def test_unresolvable_binary_degrades_to_zeros(self, monkeypatch, caplog):
+        monkeypatch.setenv("DI_HHBLITS_BIN", "/no/such/hhblits")
+        monkeypatch.setenv("DI_HHBLITS_DB", "/nonexistent/db")
+        with caplog.at_level("WARNING"):
+            out = sequence_profile("ACD")
+        assert np.all(out == 0.0)
+        assert any("not an executable" in r.message for r in caplog.records)

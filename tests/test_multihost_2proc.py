@@ -1,0 +1,135 @@
+"""Two-process jax.distributed integration test for the multi-host path.
+
+VERDICT r2 item 5: ``parallel/multihost.py`` had only single-process
+degradation coverage — here the full stack (``jax.distributed.initialize``
+over a localhost coordinator, per-host file-list sharding,
+``make_array_from_process_local_data`` batch feeding, GSPMD train steps
+over a 2-host mesh, rank-0 checkpoint/CSV gating) actually executes with
+``process_count == 2`` through the real ``cli.train`` entry point.
+
+Each subprocess gets ONE virtual CPU device, so the 2-host mesh is 2
+global devices — the smallest honest multi-host topology (reference
+analog: Lightning DDP over 2 nodes, lit_model_train.py:217,226).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.data.features import featurize_chain
+from deepinteract_tpu.data.io import save_complex_npz
+from deepinteract_tpu.data.synthetic import random_backbone, random_residue_feats
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _build_tiny_dataset(root: str, n_complexes: int = 5) -> None:
+    """Synthetic npz dataset + split files; 5 train complexes makes the
+    2-host shard wrap (ceil(5/2)=3 each, one wrapped duplicate)."""
+    processed = os.path.join(root, "processed")
+    os.makedirs(processed, exist_ok=True)
+    rng = np.random.default_rng(0)
+    names = []
+    for i in range(n_complexes):
+        raws = []
+        cas = []
+        for n, origin in ((24, np.zeros(3)), (21, np.array([10.0, 0.0, 0.0]))):
+            bb = random_backbone(n, rng, origin=origin)
+            raws.append(featurize_chain(bb, random_residue_feats(n, rng),
+                                        knn=6, geo_nbrhd_size=2, rng=rng))
+            cas.append(bb[:, 1, :])
+        d = np.linalg.norm(cas[0][:, None] - cas[1][None, :], axis=-1)
+        contact = (d < 8.0).astype(np.int32)
+        ii, jj = np.meshgrid(np.arange(24), np.arange(21), indexing="ij")
+        examples = np.stack([ii.ravel(), jj.ravel(), contact.ravel()],
+                            axis=1).astype(np.int32)
+        name = f"c{i}.npz"
+        save_complex_npz(os.path.join(processed, name), raws[0], raws[1],
+                         examples, complex_name=f"c{i}")
+        names.append(name)
+    for mode, sel in (("train", names), ("val", names[:1]), ("test", names[:1])):
+        with open(os.path.join(root, f"pairs-postprocessed-{mode}.txt"), "w") as f:
+            f.write("\n".join(sel) + "\n")
+
+
+TINY_FLAGS = [
+    "--num_gnn_layers", "1", "--num_gnn_hidden_channels", "8",
+    "--num_gnn_attention_heads", "2", "--num_interact_layers", "1",
+    "--num_interact_hidden_channels", "8", "--num_epochs", "1",
+    "--steps_per_dispatch", "1", "--log_every", "1", "--seed", "7",
+]
+
+
+@pytest.mark.slow
+def test_two_process_cli_train(tmp_path):
+    root = tmp_path / "data"
+    _build_tiny_dataset(str(root))
+    port = _free_port()
+
+    procs = []
+    for pid in range(2):
+        workdir = tmp_path / f"host{pid}"
+        workdir.mkdir()
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            JAX_TRACEBACK_FILTERING="off",
+        )
+        cmd = [
+            sys.executable, "-m", "deepinteract_tpu.cli.train",
+            "--dips_root", str(root),
+            "--ckpt_dir", str(workdir / "ckpt"),
+            "--coordinator_address", f"127.0.0.1:{port}",
+            "--num_processes", "2", "--process_id", str(pid),
+        ] + TINY_FLAGS
+        procs.append(
+            subprocess.Popen(cmd, cwd=str(workdir), env=env,
+                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                             text=True)
+        )
+
+    outs = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=1500)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"process {pid} timed out; partial output:\n"
+                        f"{proc.communicate()[0][-4000:]}")
+        outs.append(out)
+        assert proc.returncode == 0, f"process {pid} failed:\n{out[-6000:]}"
+
+    # Both hosts planned the same coordinated global epoch: 5 same-bucket
+    # complexes at global batch 2 (1 local x 2 hosts), drop_remainder ->
+    # 2 aligned steps per epoch on every host.
+    for pid, out in enumerate(outs):
+        m = re.search(r"host %d/2: (\d+) coordinated global steps" % pid, out)
+        assert m, out[-2000:]
+        assert int(m.group(1)) == 2
+
+    # Replicated training: per-epoch metrics printed by both hosts agree.
+    def epoch_line(out):
+        lines = [l for l in out.splitlines() if l.startswith("epoch 0:")]
+        assert lines, out[-2000:]
+        return lines[-1]
+
+    assert epoch_line(outs[0]) == epoch_line(outs[1])
+
+    # Rank-0 gating: primary wrote checkpoint + CSV, secondary neither.
+    assert (tmp_path / "host0" / "ckpt" / "best").is_dir()
+    assert (tmp_path / "host0" / "test_top_metrics.csv").exists()
+    assert not (tmp_path / "host1" / "ckpt").exists()
+    assert not (tmp_path / "host1" / "test_top_metrics.csv").exists()

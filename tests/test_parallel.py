@@ -121,7 +121,14 @@ def test_multihost_helpers_single_process():
     assert is_primary_host()
     names = [f"c{i}" for i in range(10)]
     assert shard_filenames_for_host(names) == names
-    # Explicit 3-host split: disjoint contiguous shards, remainder dropped.
+    # Explicit 3-host split: equal-length shards covering EVERY complex,
+    # remainder wrapped (DistributedSampler padding semantics) so no
+    # complex is permanently excluded and step counts stay aligned.
     shards = [shard_filenames_for_host(names, pi, 3) for pi in range(3)]
-    assert all(len(s) == 3 for s in shards)
-    assert len({n for s in shards for n in s}) == 9
+    assert all(len(s) == 4 for s in shards)
+    assert {n for s in shards for n in s} == set(names)
+    # Degenerate case: fewer complexes than hosts still fills every shard.
+    tiny = ["a", "b"]
+    tiny_shards = [shard_filenames_for_host(tiny, pi, 5) for pi in range(5)]
+    assert all(len(s) == 1 for s in tiny_shards)
+    assert {n for s in tiny_shards for n in s} == set(tiny)
