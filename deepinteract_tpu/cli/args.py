@@ -85,6 +85,13 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--steps_per_dispatch", type=int, default=8,
                    help="train steps scanned per device dispatch; amortizes "
                         "host round-trip cost (1 = classic per-step)")
+    g.add_argument("--eval_batch_size", type=int, default=1,
+                   help="complexes per eval batch (metrics stay "
+                        "per-complex; >1 amortizes dispatch + fills the "
+                        "chip during val/test epochs)")
+    g.add_argument("--eval_batches_per_dispatch", type=int, default=8,
+                   help="eval batches scanned per device dispatch "
+                        "(1 = classic per-batch)")
     g.add_argument("--patience", type=int, default=5)
     g.add_argument("--min_delta", type=float, default=5e-6)
     g.add_argument("--metric_to_track", type=str, default="val_ce")
@@ -194,6 +201,7 @@ def configs_from_args(
         swa=args.stochastic_weight_avg,
         viz_every_n_epochs=args.viz_every_n_epochs,
         steps_per_dispatch=args.steps_per_dispatch,
+        eval_batches_per_dispatch=args.eval_batches_per_dispatch,
     )
     return model_cfg, optim_cfg, loop_cfg
 

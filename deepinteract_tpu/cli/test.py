@@ -37,7 +37,7 @@ def main(argv=None) -> int:
         split_ver=args.split_ver,
         seed=args.seed,
     )
-    test_loader = BucketedLoader(dm.test, batch_size=1)
+    test_loader = BucketedLoader(dm.test, batch_size=args.eval_batch_size)
 
     model = DeepInteract(model_cfg)
     trainer = Trainer(model, loop_cfg, optim_cfg, mesh=make_mesh_from_args(args))

@@ -84,8 +84,8 @@ def main(argv=None) -> int:
         print(f"host {shard[0]}/{shard[1]}: {train_loader.num_batches()} "
               f"coordinated global steps/epoch, {args.batch_size} local x "
               f"{shard[1]} hosts per step")
-    val_loader = BucketedLoader(dm.val, batch_size=1)
-    test_loader = BucketedLoader(dm.test, batch_size=1)
+    val_loader = BucketedLoader(dm.val, batch_size=args.eval_batch_size)
+    test_loader = BucketedLoader(dm.test, batch_size=args.eval_batch_size)
 
     # Calibrate the cosine-restart schedule on the actual epoch length
     # (reference T_0=10 epochs, deepinteract_modules.py:2196).

@@ -39,40 +39,39 @@ def make_mesh(
     return Mesh(arr, (DATA_AXIS, PAIR_AXIS))
 
 
-def shard_batch(batch, mesh: Mesh):
-    """Place a stacked batch pytree with its leading axis split over
-    ``data`` (the per-host sharded-file-list analog of Lightning's
-    DistributedSampler).
+def _place(tree, mesh: Mesh, spec: P, replicated: bool = False):
+    """Place a pytree with one sharding spec.
 
-    Single-process: a plain sharded ``device_put``. Multi-process (mesh
-    spans hosts): each host contributes its *local* batch as its shard of
-    the global array (``jax.make_array_from_process_local_data``) — the
-    global batch is the concatenation over hosts, so a per-host
-    local batch of B complexes trains a global batch of
-    ``B * process_count`` exactly like DDP."""
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    Single-process: plain sharded ``device_put``. Multi-process (mesh
+    spans hosts): each host contributes its *local* arrays as its shard of
+    the global array (``jax.make_array_from_process_local_data``); for
+    fully-replicated specs the global shape equals the local shape."""
+    sharding = NamedSharding(mesh, spec)
     if jax.process_count() > 1:
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(
-                sharding, np.asarray(x)
-            ),
-            batch,
-        )
-    return jax.device_put(batch, sharding)
-
-
-def replicate(tree, mesh: Mesh):
-    """Fully replicate a pytree (params/opt state) across the mesh.
-
-    Multi-process meshes build the global replicated array from each
-    host's (identical, same-seed) local copy; the global shape equals the
-    local shape since nothing is partitioned."""
-    sharding = NamedSharding(mesh, P())
-    if jax.process_count() > 1:
-        return jax.tree_util.tree_map(
-            lambda x: jax.make_array_from_process_local_data(
-                sharding, np.asarray(x), np.shape(x)
+                sharding, np.asarray(x), np.shape(x) if replicated else None
             ),
             tree,
         )
     return jax.device_put(tree, sharding)
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a stacked batch pytree with its leading axis split over
+    ``data``. Multi-process: the global batch is the concatenation of the
+    hosts' local batches, so a per-host batch of B complexes trains a
+    global batch of ``B * process_count`` exactly like DDP."""
+    return _place(batch, mesh, P(DATA_AXIS))
+
+
+def shard_stacked_batch(stacked, mesh: Mesh):
+    """Like :func:`shard_batch` for [K, B, ...] scan-stacked batches: the
+    scan axis stays unsharded, the batch axis splits over ``data``."""
+    return _place(stacked, mesh, P(None, DATA_AXIS))
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree (params/opt state) across the mesh, built
+    multi-process from each host's (identical, same-seed) local copy."""
+    return _place(tree, mesh, P(), replicated=True)

@@ -91,15 +91,18 @@ def shard_filenames_for_host(
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
 ) -> list:
-    """This host's shard of the (already shuffled) complex list — the
-    DistributedSampler analog. Every host must receive the same
-    ``filenames`` ordering (same seed) for shards to be disjoint.
+    """This host's shard of a work list (same ``filenames`` ordering on
+    every host -> disjoint shards; remainder wrapped like torch's
+    DistributedSampler so shard lengths match and nothing is permanently
+    excluded).
 
-    torch DistributedSampler semantics: when ``len(filenames)`` is not a
-    multiple of the host count, the list is padded by wrapping around to
-    the front, so every complex is seen each epoch (a few appear twice)
-    and every host runs the same number of steps — a straggler host would
-    deadlock collectives at epoch end."""
+    Use for embarrassingly-parallel per-host work WITHOUT global
+    collectives — bulk featurization, dataset building, analysis sweeps.
+    Do NOT use it to split a *training* file list: per-host lists give
+    hosts different bucket distributions/batch shapes and deadlock the
+    global train collectives — training shards through the coordinated
+    ``BucketedLoader(shard=(process_index, process_count))`` plan instead
+    (data/loader.py, wired in cli/train.py)."""
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
     if pc <= 1:
@@ -137,32 +140,23 @@ def host_local_array(x):
 
     if getattr(x, "is_fully_addressable", True):
         return np.asarray(x)
-    shards = {s.index: s for s in x.addressable_shards}  # dedup replicas
-    if len(shards) == 1:
-        return np.asarray(next(iter(shards.values())).data)
-
-    def start(idx, axis):
-        return (idx[axis].start or 0) if x.ndim > axis else 0
-
-    for idx in shards:
-        for axis in range(2, x.ndim):
-            if start(idx, axis) != 0:
-                raise ValueError(
-                    f"host_local_array: axis {axis} is partitioned "
-                    "(only axes 0/1 are reassembled); gather on device first"
-                )
-    rows = {}
-    for idx, s in shards.items():
-        rows.setdefault(start(idx, 0), {})[start(idx, 1)] = np.asarray(s.data)
-    out_rows = []
-    for a0 in sorted(rows):
-        cols = [rows[a0][k] for k in sorted(rows[a0])]
-        row = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
-        if x.ndim >= 2 and row.shape[1] != x.shape[1]:
-            raise ValueError(
-                "host_local_array: axis 1 shards on this host do not cover "
-                "the full dimension (pair axis spans hosts); gather on "
-                "device before reading"
-            )
-        out_rows.append(row)
-    return out_rows[0] if len(out_rows) == 1 else np.concatenate(out_rows, axis=0)
+    shards = {s.index: np.asarray(s.data) for s in x.addressable_shards}
+    if len(shards) == 1:  # replicated (or scalar): one distinct index
+        return next(iter(shards.values()))
+    # GSPMD shards tile a regular grid; reassemble this host's sub-grid
+    # along every axis via np.block. Axes partitioned across *hosts* come
+    # back smaller than the global dim — callers that need full coverage
+    # must validate the returned shape (Trainer.evaluate does).
+    starts = [
+        sorted({(idx[a].start or 0) for idx in shards}) for a in range(x.ndim)
+    ]
+    pos = [{st: i for i, st in enumerate(s)} for s in starts]
+    blocks = np.empty([len(s) for s in starts], dtype=object)
+    for idx, data in shards.items():
+        blocks[tuple(pos[a][idx[a].start or 0] for a in range(x.ndim))] = data
+    if any(b is None for b in blocks.ravel()):
+        raise ValueError(
+            "host_local_array: local shards do not tile a complete grid; "
+            "gather on device before reading"
+        )
+    return np.block(blocks.tolist())

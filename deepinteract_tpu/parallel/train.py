@@ -64,3 +64,18 @@ def make_sharded_eval_step(mesh: Mesh, weight_classes: bool = False):
         in_shardings=(replicated, batch_sharded),
         out_shardings=None,
     )
+
+
+def make_sharded_multi_eval_step(mesh: Mesh, weight_classes: bool = False):
+    """Sharded :func:`deepinteract_tpu.training.steps.multi_eval_step`:
+    stacked [K, B, ...] batches, scan axis unsharded, batch over ``data``."""
+    from deepinteract_tpu.training.steps import multi_eval_step
+
+    replicated = NamedSharding(mesh, P())
+    batch_sharded = NamedSharding(mesh, P(None, DATA_AXIS))
+    step = partial(multi_eval_step, weight_classes=weight_classes)
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch_sharded),
+        out_shardings=None,
+    )

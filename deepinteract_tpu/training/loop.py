@@ -70,6 +70,10 @@ class LoopConfig:
     # step-per-dispatch. Consecutive same-shape batches are grouped; odd
     # remainders fall back to single steps.
     steps_per_dispatch: int = 1
+    # Same amortization for evaluation: scan K eval forwards per dispatch
+    # (consecutive same-shape val batches). At batch 1 the host round-trip
+    # dominates a DIPS-scale val epoch (3,548 complexes); 1 disables.
+    eval_batches_per_dispatch: int = 8
 
 
 class EarlyStopping:
@@ -106,6 +110,30 @@ def _iter_data(data: DataSource, epoch: int) -> Iterable[PairedComplex]:
     return data(epoch) if callable(data) else data
 
 
+def _shape_runs(items: Iterable[PairedComplex], k: int):
+    """Group consecutive same-shape batches into runs of up to ``k`` for
+    scanned dispatch (shape key = tuple of pytree leaf shapes). Runs
+    shorter than ``k`` (remainders, shape changes, or ``k == 1``) are
+    dispatched per-batch by the callers — a fresh odd-length scan would
+    compile minutes to run once."""
+    buffer: List[PairedComplex] = []
+    buffer_key = None
+    for item in items:
+        key = tuple(
+            getattr(l, "shape", ()) for l in jax.tree_util.tree_leaves(item)
+        )
+        if buffer and key != buffer_key:
+            yield buffer
+            buffer = []
+        buffer_key = key
+        buffer.append(item)
+        if len(buffer) == k:
+            yield buffer
+            buffer = []
+    if buffer:
+        yield buffer
+
+
 class Trainer:
     """Drives train/val epochs over jitted steps.
 
@@ -130,22 +158,32 @@ class Trainer:
         self.mesh = mesh
         self.log = log_fn
         self.metric_writer = metric_writer
-        from deepinteract_tpu.training.steps import multi_train_step
+        from deepinteract_tpu.training.steps import multi_eval_step, multi_train_step
 
         if mesh is not None:
             from deepinteract_tpu.parallel.train import (
                 make_sharded_eval_step,
+                make_sharded_multi_eval_step,
                 make_sharded_multi_step,
                 make_sharded_train_step,
             )
 
+            # donate=True: the Trainer threads one live state through the
+            # epoch (state = step(state, ...)), so XLA may reuse the old
+            # state's HBM in place — without it every mesh step pays a full
+            # state copy. Anything needing the pre-step state (tests
+            # comparing against a kept reference) builds its own step with
+            # donate=False.
             self._train_step = make_sharded_train_step(
-                mesh, weight_classes=loop_cfg.weight_classes, donate=False
+                mesh, weight_classes=loop_cfg.weight_classes, donate=True
             )
             self._multi_step = make_sharded_multi_step(
-                mesh, weight_classes=loop_cfg.weight_classes, donate=False
+                mesh, weight_classes=loop_cfg.weight_classes, donate=True
             )
             self._eval_step = make_sharded_eval_step(mesh, weight_classes=loop_cfg.weight_classes)
+            self._multi_eval = make_sharded_multi_eval_step(
+                mesh, weight_classes=loop_cfg.weight_classes
+            )
         else:
             self._train_step = jax.jit(
                 lambda s, b: train_step(s, b, weight_classes=loop_cfg.weight_classes)
@@ -155,6 +193,9 @@ class Trainer:
             )
             self._eval_step = jax.jit(
                 lambda s, b: eval_step(s, b, weight_classes=loop_cfg.weight_classes)
+            )
+            self._multi_eval = jax.jit(
+                lambda s, bs: multi_eval_step(s, bs, weight_classes=loop_cfg.weight_classes)
             )
 
     # -- state construction ------------------------------------------------
@@ -199,20 +240,28 @@ class Trainer:
         csv_path: Optional[str] = None,
     ) -> Dict[str, float]:
         """Eval pass producing the reference metric suite (median over
-        complexes; ``stage`` picks the L convention)."""
+        complexes; ``stage`` picks the L convention).
+
+        Dispatch batching: consecutive same-shape batches are stacked and
+        scanned K-per-dispatch (LoopConfig.eval_batches_per_dispatch, the
+        eval twin of the train path's scanned dispatch) — at batch 1 the
+        ~25 ms host round-trip otherwise dominates a DIPS-scale val epoch.
+        """
         per_complex: List[Dict[str, float]] = []
         used_targets: List[str] = []
         idx = 0
-        for host_batch in _iter_data(val_data, 0):
-            batch = self._device_batch(host_batch)
-            out = self._eval_step(state, batch)
-            # Multi-host: every host feeds the same complexes, so this
-            # host's local shard of the global outputs is exactly what
-            # host_batch holds — metrics come out identical on all hosts.
-            probs = host_local_array(out["probs"])
-            logits = host_local_array(out["logits"])
-            bsz = probs.shape[0]
-            for b in range(bsz):
+
+        def consume(host_batch, probs, logits):
+            """Per-complex metrics from one batch's host-local outputs."""
+            nonlocal idx
+            expected = np.asarray(host_batch.contact_map).shape[:3]
+            if tuple(probs.shape[:3]) != expected:
+                raise ValueError(
+                    f"eval outputs {probs.shape} do not cover the local "
+                    f"batch {expected}: an output axis is sharded across "
+                    "hosts; use a within-host pair sharding for eval"
+                )
+            for b in range(probs.shape[0]):
                 n1 = int(np.asarray(host_batch.graph1.num_nodes)[b])
                 n2 = int(np.asarray(host_batch.graph2.num_nodes)[b])
                 examples = np.asarray(host_batch.examples)[b]
@@ -227,6 +276,26 @@ class Trainer:
                 )
                 used_targets.append(targets[idx] if targets else f"complex_{idx}")
                 idx += 1
+
+        # Multi-host note: every host feeds the same complexes, so this
+        # host's local shard of the global outputs is exactly what
+        # host_batch holds — metrics come out identical on all hosts.
+        k = max(1, self.cfg.eval_batches_per_dispatch)
+        for run in _shape_runs(_iter_data(val_data, 0), k):
+            if len(run) < max(k, 2):
+                for hb in run:
+                    out = self._eval_step(state, self._device_batch(hb))
+                    consume(hb, host_local_array(out["probs"]),
+                            host_local_array(out["logits"]))
+            else:
+                from deepinteract_tpu.training.steps import stack_microbatches
+
+                out = self._multi_eval(
+                    state, self._device_stacked(stack_microbatches(run)))
+                probs = host_local_array(out["probs"])
+                logits = host_local_array(out["logits"])
+                for j, hb in enumerate(run):
+                    consume(hb, probs[j], logits[j])
         agg = M.aggregate_median(per_complex)
         agg = {f"{stage}_{k}" if not k.startswith("med_") else f"med_{stage}_{k[4:]}": v
                for k, v in agg.items()}
@@ -270,13 +339,15 @@ class Trainer:
                 # host must receive the restored state and epoch, or the
                 # hosts would train different weights over different epoch
                 # ranges (split-brain + collective deadlock at the end).
+                # The epoch goes first on its own: a fresh start (no
+                # checkpoint) then skips broadcasting the full state tree.
                 from jax.experimental import multihost_utils
 
-                start_epoch, tree = multihost_utils.broadcast_one_to_all(
-                    (np.asarray(start_epoch), state_to_tree(state))
-                )
-                start_epoch = int(start_epoch)
+                start_epoch = int(multihost_utils.broadcast_one_to_all(
+                    np.asarray(start_epoch)))
                 if start_epoch > 0:
+                    tree = multihost_utils.broadcast_one_to_all(
+                        state_to_tree(state))
                     state = _restore_into(
                         state, jax.tree_util.tree_map(np.asarray, tree))
 
@@ -376,8 +447,6 @@ class Trainer:
 
         cfg = self.cfg
         k = max(1, cfg.steps_per_dispatch)
-        buffer: List[PairedComplex] = []
-        buffer_key = None
         step_idx = 0
 
         def log_step(metrics):
@@ -393,45 +462,22 @@ class Trainer:
                     f"grad_norm={float(host_local_array(metrics['grad_norm'])):.4f}"
                 )
 
-        def flush(state):
-            nonlocal buffer
-            if not buffer:
-                return state
-            if len(buffer) == 1 or len(buffer) < k:
-                # Single batch, or a remainder shorter than K: run single
-                # steps on the already-compiled per-step path — a scan over
-                # an odd length would trigger a fresh multi-minute XLA
-                # compile to run once per epoch.
-                for b in buffer:
+        for run in _shape_runs(_iter_data(train_data, epoch), k):
+            if len(run) < max(k, 2):
+                for b in run:
                     state, metrics = self._train_step(state, self._device_batch(b))
                     log_step(metrics)
             else:
-                # Buffered batches stay on host; they are stacked here and
-                # placed once by the jitted multi-step's in_shardings (one
-                # host->device transfer per dispatch, which is the point —
-                # device_put-ing each batch first would force K
-                # device->host->device round-trips through np.stack).
-                state, stacked = self._multi_step(state, stack_microbatches(buffer))
-                for j in range(len(buffer)):
+                # Buffered batches stay on host until stacked here; ONE
+                # placement per dispatch (device_put-ing each batch first
+                # would force K device->host->device round-trips through
+                # np.stack). Multi-host needs the explicit global-array
+                # construction in _device_stacked.
+                state, stacked = self._multi_step(
+                    state, self._device_stacked(stack_microbatches(run)))
+                for j in range(len(run)):
                     log_step(jax.tree_util.tree_map(lambda m: m[j], stacked))
-            buffer = []
-            return state
-
-        for batch in _iter_data(train_data, epoch):
-            key = tuple(
-                getattr(l, "shape", ()) for l in jax.tree_util.tree_leaves(batch)
-            )
-            if k == 1:
-                buffer = [batch]
-                state = flush(state)
-                continue
-            if buffer_key is not None and key != buffer_key:
-                state = flush(state)
-            buffer_key = key
-            buffer.append(batch)
-            if len(buffer) == k:
-                state = flush(state)
-        return flush(state)
+        return state
 
     def _device_batch(self, batch: PairedComplex) -> PairedComplex:
         if self.mesh is not None:
@@ -439,6 +485,16 @@ class Trainer:
 
             return shard_batch(batch, self.mesh)
         return batch
+
+    def _device_stacked(self, stacked: PairedComplex) -> PairedComplex:
+        """Place a [K, B, ...] scan-stack (multi-host: global arrays from
+        this host's local slice; single-process mesh/jit handles placement
+        from in_shardings, but explicit placement keeps one path)."""
+        if self.mesh is not None:
+            from deepinteract_tpu.parallel.mesh import shard_stacked_batch
+
+            return shard_stacked_batch(stacked, self.mesh)
+        return stacked
 
     def _refresh_batch_stats(self, state: TrainState, train_data: DataSource) -> TrainState:
         """One forward pass over the training data in train mode, updating
@@ -470,7 +526,14 @@ class Trainer:
         out = self._eval_step(state, batch)
         if self.metric_writer is None:
             return  # non-primary host: participated in the collective only
-        probs = host_local_array(out["probs"])[0, ..., -1]  # [L1, L2] positive class
+        probs_full = host_local_array(out["probs"])
+        expected = np.asarray(host_batch.contact_map).shape[:3]
+        if tuple(probs_full.shape[:3]) != expected:
+            raise ValueError(
+                f"viz eval outputs {probs_full.shape} do not cover the local "
+                f"batch {expected}: an output axis is sharded across hosts"
+            )
+        probs = probs_full[0, ..., -1]  # [L1, L2] positive class
         n1 = int(np.asarray(host_batch.graph1.num_nodes)[0])
         n2 = int(np.asarray(host_batch.graph2.num_nodes)[0])
         pred = (probs[:n1, :n2, None] * 255).astype(np.uint8)

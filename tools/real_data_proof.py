@@ -66,6 +66,7 @@ def derive_fragment_pairs(work_dir: str, window: int = 100):
     write_pdb(right, os.path.join(input_dir, "4heq_full_r_u.pdb"))
 
     n1, n2 = len(left), len(right)
+    window = min(window, n1, n2)  # chains shorter than the window: one full-chain "fragment"
     stride = 15
     starts1 = sorted(set(range(0, n1 - window + 1, stride)) | {n1 - window})
     starts2 = sorted(set(range(0, n2 - window + 1, stride)) | {n2 - window})
