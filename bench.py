@@ -369,50 +369,50 @@ def main() -> None:
                 "analytic_train_mfu": round(entry["analytic_train_mfu"], 4),
             }), flush=True)
 
-    # Pallas-vs-jnp A/B on the TPU at the headline bucket (the kernel's
-    # supported regime). Forced impls so 'auto' heuristics cannot hide a
-    # regression; measured on forward + train step.
+    # Pallas-vs-jnp A/B on the TPU at the headline bucket and at the
+    # reference's 256-residue regime (the kernel's new edge-block grid).
+    # Forced impls so 'auto' heuristics cannot hide a regression; measured
+    # on forward + train step.
     if dev.platform == "tpu" and not os.environ.get("DI_BENCH_FAST"):
-        try:
-            from deepinteract_tpu.ops.pallas_attention import supports
+        from deepinteract_tpu.ops.pallas_attention import supports
 
-            ab = {}
-            for impl in ("jnp", "pallas"):
-                if impl == "pallas" and not supports(128):
-                    ab["pallas"] = {"skipped": "kernel does not support pad 128"}
-                    continue
-                m = make_model(attention_impl=impl)
-                batch = _make_batch(1, 100, 80, 128)
-                state = create_train_state(
-                    m, batch, optim_cfg=OptimConfig(steps_per_epoch=100,
-                                                    num_epochs=50),
-                )
-                import jax as _jax
-
-                from deepinteract_tpu.training.steps import train_step as _ts
-
-                fwd = _jax.jit(
-                    lambda params, bstats, b, _m=m: _m.apply(
-                        {"params": params, "batch_stats": bstats},
-                        b.graph1, b.graph2, train=False,
+        for pad, (n1, n2) in ((128, (100, 80)), (256, (230, 200))):
+            key = f"attention_ab_b1_p{pad}"
+            try:
+                ab = {}
+                for impl in ("jnp", "pallas"):
+                    if impl == "pallas" and not supports(pad):
+                        ab["pallas"] = {"skipped": f"kernel does not support pad {pad}"}
+                        continue
+                    m = make_model(attention_impl=impl)
+                    batch = _make_batch(1, n1, n2, pad)
+                    state = create_train_state(
+                        m, batch, optim_cfg=OptimConfig(steps_per_epoch=100,
+                                                        num_epochs=50),
                     )
-                )
-                _, ft, _ = _time_compiled(
-                    fwd, (state.params, state.batch_stats, batch))
-                tstep = _jax.jit(lambda s, b: _ts(s, b))
-                _, tt, _ = _time_compiled(tstep, (state, batch))
-                ab[impl] = {"forward_ms": ft["median"] * 1e3,
-                            "train_ms": tt["median"] * 1e3}
-            if "forward_ms" in ab.get("pallas", {}):
-                ab["pallas_speedup_forward"] = (
-                    ab["jnp"]["forward_ms"] / ab["pallas"]["forward_ms"])
-                ab["pallas_speedup_train"] = (
-                    ab["jnp"]["train_ms"] / ab["pallas"]["train_ms"])
-            detail["attention_ab_b1_p128"] = ab
-            _log(json.dumps({"attention_ab_b1_p128": ab}))
-        except Exception as exc:
-            detail["attention_ab_b1_p128"] = {
-                "error": str(exc).splitlines()[0][:300]}
+                    from deepinteract_tpu.training.steps import train_step as _ts
+
+                    fwd = jax.jit(
+                        lambda params, bstats, b, _m=m: _m.apply(
+                            {"params": params, "batch_stats": bstats},
+                            b.graph1, b.graph2, train=False,
+                        )
+                    )
+                    _, ft, _ = _time_compiled(
+                        fwd, (state.params, state.batch_stats, batch))
+                    tstep = jax.jit(lambda s, b: _ts(s, b))
+                    _, tt, _ = _time_compiled(tstep, (state, batch))
+                    ab[impl] = {"forward_ms": ft["median"] * 1e3,
+                                "train_ms": tt["median"] * 1e3}
+                if "forward_ms" in ab.get("pallas", {}):
+                    ab["pallas_speedup_forward"] = (
+                        ab["jnp"]["forward_ms"] / ab["pallas"]["forward_ms"])
+                    ab["pallas_speedup_train"] = (
+                        ab["jnp"]["train_ms"] / ab["pallas"]["train_ms"])
+                detail[key] = ab
+                _log(json.dumps({key: ab}))
+            except Exception as exc:
+                detail[key] = {"error": str(exc).splitlines()[0][:300]}
 
     # Eval-path throughput: the per-complex dispatch the r2 Trainer used vs
     # the batched + scanned eval (VERDICT r2 item 6). DIPS-Plus validation

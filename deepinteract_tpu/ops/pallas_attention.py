@@ -18,11 +18,15 @@ in VMEM.
 Numerics are bit-compatible with ``edge_attention(..., mode='scatter')``
 (same clip/eps constants); the parity test drives both on the same inputs.
 
-Scope: whole-graph-in-VMEM formulation, used for padded buckets up to
-``MAX_KERNEL_NODES`` nodes (the flagship 64/128 buckets); larger buckets
-fall back to the jnp path automatically. Backward runs through
-``jax.custom_vjp`` delegating to the jnp reference implementation's VJP —
-semantics-identical gradients with zero duplicated math.
+Scope: an edge-block grid keeps every working set in VMEM at any bucket up
+to ``MAX_KERNEL_NODES`` (the full reference regime — 256 residues,
+deepinteract_constants.py:10-12). Buckets <= 128 nodes run as one block
+(whole graph resident); larger buckets split the edge list into
+``n // 64`` blocks, accumulate the per-node numerator in the (revisited)
+output block and the softmax denominator in VMEM scratch, and normalize in
+the final grid step. Backward runs through ``jax.custom_vjp`` delegating
+to the jnp reference implementation's VJP — semantics-identical gradients
+with zero duplicated math.
 """
 
 from __future__ import annotations
@@ -36,29 +40,38 @@ from jax.experimental.pallas import tpu as pltpu
 
 from deepinteract_tpu.ops.attention import CLIP, EPS, edge_attention
 
-# Whole-graph VMEM budget: E = N*K rows of [H*D] floats plus two [E, N]
-# one-hot selectors. N=128, K=20, HD=128 => ~13 MB, inside a v5e core's VMEM.
-MAX_KERNEL_NODES = 128
+# Largest supported padded bucket (= the reference's RESIDUE_COUNT_LIMIT).
+# Per-block VMEM at N=256, K=20, HD=128 with n//64 = 4 edge blocks:
+# two [1280, 256] one-hot selectors (~1.3 MB each), [1280, 128] edge tiles
+# (~0.65 MB each) and two [256, 128] accumulators — comfortably inside a
+# v5e core's ~16 MB VMEM (the whole-graph formulation needs ~26 MB there).
+MAX_KERNEL_NODES = 256
+
+
+def _num_edge_blocks(n: int) -> int:
+    return 1 if n <= 128 else n // 64
 
 
 def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
-            *, num_nodes: int, knn: int, num_heads: int, head_dim: int):
+            z_acc, *, num_nodes: int, knn: int, num_heads: int,
+            head_dim: int, num_eblocks: int):
     n, kk, h, d = num_nodes, knn, num_heads, head_dim
     hd = h * d
-    e = n * kk
+    eb = n * kk // num_eblocks  # edges per grid block
     f32 = jnp.float32
+    j = pl.program_id(1)
 
-    nbr = nbr_ref[0]          # [E, 1] int32
-    mask = mask_ref[0]        # [E, 1] f32
+    nbr = nbr_ref[0]          # [EB, 1] int32
+    mask = mask_ref[0]        # [EB, 1] f32
     q = q_ref[0]              # [N, HD]
     k = k_ref[0]
     v = v_ref[0]
-    pe = pe_ref[0]            # [E, HD]
+    pe = pe_ref[0]            # [EB, HD]
 
-    node_ids = jax.lax.broadcasted_iota(jnp.int32, (e, n), 1)
-    onehot_dst = (nbr == node_ids).astype(f32)                      # [E, N]
-    src_ids = jax.lax.broadcasted_iota(jnp.int32, (e, 1), 0) // kk
-    onehot_src = (src_ids == node_ids).astype(f32)                  # [E, N]
+    node_ids = jax.lax.broadcasted_iota(jnp.int32, (eb, n), 1)
+    onehot_dst = (nbr == node_ids).astype(f32)                      # [EB, N]
+    src_ids = (jax.lax.broadcasted_iota(jnp.int32, (eb, 1), 0) + j * eb) // kk
+    onehot_src = (src_ids == node_ids).astype(f32)                  # [EB, N]
 
     # Per-head sum / broadcast as block-diagonal 0/1 matmuls.
     lane_head = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
@@ -66,16 +79,16 @@ def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
     sum_mat = (lane_head == head_ids).astype(f32)                   # [HD, H]
 
     dot = functools.partial(jnp.dot, preferred_element_type=f32)
-    q_dst = dot(onehot_dst, q)                                      # [E, HD]
+    q_dst = dot(onehot_dst, q)                                      # [EB, HD]
     k_src = dot(onehot_src, k)
     v_src = dot(onehot_src, v)
 
     inv_sqrt_d = 1.0 / (d ** 0.5)
-    scaled = jnp.clip(k_src * q_dst * inv_sqrt_d, -CLIP, CLIP) * pe  # [E, HD]
-    logits = jnp.clip(dot(scaled, sum_mat), -CLIP, CLIP)             # [E, H]
-    w = jnp.exp(logits) * mask                                       # [E, H]
+    scaled = jnp.clip(k_src * q_dst * inv_sqrt_d, -CLIP, CLIP) * pe  # [EB, HD]
+    logits = jnp.clip(dot(scaled, sum_mat), -CLIP, CLIP)             # [EB, H]
+    w = jnp.exp(logits) * mask                                       # [EB, H]
 
-    w_full = dot(w, sum_mat.T)                                       # [E, HD]
+    w_full = dot(w, sum_mat.T)                                       # [EB, HD]
     x = w_full * v_src
     wv = jax.lax.dot_general(onehot_dst, x, (((0,), (0,)), ((), ())),
                              preferred_element_type=f32)             # [N, HD]
@@ -83,8 +96,21 @@ def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
                             preferred_element_type=f32)              # [N, H]
     z_full = dot(z, sum_mat.T)                                       # [N, HD]
 
-    h_ref[0] = wv / (z_full + EPS)
     e_ref[0] = scaled * mask
+
+    # Numerator accumulates in the revisited output block, denominator in
+    # scratch; both zeroed on the first edge block, normalized on the last.
+    @pl.when(j == 0)
+    def _init():
+        h_ref[0] = jnp.zeros((n, hd), f32)
+        z_acc[...] = jnp.zeros((n, hd), f32)
+
+    h_ref[0] += wv
+    z_acc[...] += z_full
+
+    @pl.when(j == num_eblocks - 1)
+    def _normalize():
+        h_ref[0] = h_ref[0] / (z_acc[...] + EPS)
 
 
 def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
@@ -92,30 +118,33 @@ def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
     kk = nbr_idx.shape[-1]
     e = n * kk
     hd = h * d
+    nb = _num_edge_blocks(n)
+    eb = e // nb
 
     kernel = functools.partial(
-        _kernel, num_nodes=n, knn=kk, num_heads=h, head_dim=d
+        _kernel, num_nodes=n, knn=kk, num_heads=h, head_dim=d, num_eblocks=nb
     )
     flat = lambda t: t.reshape(b, -1, hd)  # noqa: E731
     h_out, e_out = pl.pallas_call(
         kernel,
-        grid=(b,),
+        grid=(b, nb),
         in_specs=[
-            pl.BlockSpec((1, e, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, e, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, e, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, eb, 1), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, eb, 1), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, hd), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, hd), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, hd), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, eb, hd), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, n, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, e, hd), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, hd), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, eb, hd), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, n, hd), jnp.float32),
             jax.ShapeDtypeStruct((b, e, hd), jnp.float32),
         ],
+        scratch_shapes=[pltpu.VMEM((n, hd), jnp.float32)],
         interpret=interpret,
     )(
         nbr_idx.reshape(b, e, 1).astype(jnp.int32),
@@ -159,5 +188,9 @@ edge_attention_pallas.defvjp(_fwd, _bwd)
 
 
 def supports(n: int) -> bool:
-    """Whether the whole-graph kernel formulation applies to this bucket."""
-    return n <= MAX_KERNEL_NODES
+    """Whether the kernel applies to this bucket: whole-graph up to 128
+    nodes, edge-block grid (requires the 64-multiple bucket sizes the
+    loader produces) up to the reference's 256-residue regime."""
+    if n <= 128:
+        return True
+    return n <= MAX_KERNEL_NODES and n % 64 == 0

@@ -60,4 +60,28 @@ def test_gradient_parity(rng):
 
 def test_supports_guard():
     assert supports(64) and supports(128)
-    assert not supports(256)
+    # Edge-block grid extends to the reference's 256-residue regime
+    # (deepinteract_constants.py:10-12); >128 needs the loader's
+    # 64-multiple buckets.
+    assert supports(192) and supports(256)
+    assert not supports(320)
+    assert not supports(200)
+
+
+def test_forward_parity_blocked_256(rng):
+    """The >128-node edge-block grid path (4 blocks at n=256) must match
+    the jnp scatter reference, including the cross-block accumulation and
+    final-step normalization."""
+    q, k, v, pe, nbr, mask = _jnp_inputs(rng, b=1, n=256, k=4, h=2, d=16)
+    h_ref, e_ref = edge_attention(q, k, v, pe, nbr, mask, mode="scatter")
+    h_ker, e_ker = edge_attention_pallas(q, k, v, pe, nbr, mask, True)
+    np.testing.assert_allclose(np.asarray(h_ker), np.asarray(h_ref), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(e_ker), np.asarray(e_ref), rtol=1e-5, atol=1e-5)
+
+
+def test_forward_parity_blocked_192(rng):
+    q, k, v, pe, nbr, mask = _jnp_inputs(rng, b=2, n=192, k=4, h=2, d=8)
+    h_ref, e_ref = edge_attention(q, k, v, pe, nbr, mask, mode="scatter")
+    h_ker, e_ker = edge_attention_pallas(q, k, v, pe, nbr, mask, True)
+    np.testing.assert_allclose(np.asarray(h_ker), np.asarray(h_ref), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(e_ker), np.asarray(e_ref), rtol=1e-5, atol=1e-5)
