@@ -374,11 +374,11 @@ def main() -> None:
     # Forced impls so 'auto' heuristics cannot hide a regression; measured
     # on forward + train step.
     if dev.platform == "tpu" and not os.environ.get("DI_BENCH_FAST"):
-        from deepinteract_tpu.ops.pallas_attention import supports
-
         for pad, (n1, n2) in ((128, (100, 80)), (256, (230, 200))):
             key = f"attention_ab_b1_p{pad}"
             try:
+                from deepinteract_tpu.ops.pallas_attention import supports
+
                 ab = {}
                 for impl in ("jnp", "pallas"):
                     if impl == "pallas" and not supports(pad):

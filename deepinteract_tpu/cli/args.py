@@ -55,6 +55,10 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                    help="decoder ResNet chunks")
     g.add_argument("--num_interact_hidden_channels", type=int, default=128)
     g.add_argument("--use_interact_attention", action="store_true")
+    g.add_argument("--deeplab_output_stride", type=int, choices=(8, 16),
+                   default=16,
+                   help="DeepLabV3+ encoder output stride "
+                        "(vision_modules.py:99-110,256)")
     g.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
                    default="float32",
                    help="decoder activation dtype; bfloat16 halves HBM "
@@ -129,6 +133,13 @@ def add_logging_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--experiment_name", type=str, default=None)
     g.add_argument("--tb_log_dir", type=str, default=None,
                    help="TensorBoard scalar log directory")
+    g.add_argument("--use_wandb", action="store_true",
+                   help="log to Weights & Biases (reference default logger, "
+                        "lit_model_train.py:169-177); degrades with a "
+                        "warning when wandb is unavailable")
+    g.add_argument("--wandb_project", type=str, default="DeepInteract-TPU")
+    g.add_argument("--offline", action="store_true",
+                   help="wandb offline mode (reference --offline flag)")
     g.add_argument("--profile_dir", type=str, default=None,
                    help="capture a jax.profiler trace of the first train "
                         "epoch into this directory")
@@ -174,7 +185,8 @@ def configs_from_args(
     model_cfg = ModelConfig(
         gnn=gnn,
         decoder=decoder,
-        deeplab=DeepLabConfig(dropout_rate=args.dropout_rate, remat=args.remat),
+        deeplab=DeepLabConfig(dropout_rate=args.dropout_rate, remat=args.remat,
+                              output_stride=args.deeplab_output_stride),
         gnn_layer_type=args.gnn_layer_type,
         interact_module_type=args.interact_module_type,
         shard_pair_map=args.shard_pair_map or args.num_pair_shards > 1,
@@ -215,8 +227,25 @@ def make_mesh_from_args(args) -> Optional[object]:
 
 
 def make_metric_writer(args):
+    writers = []
     if getattr(args, "tb_log_dir", None):
         from tensorboardX import SummaryWriter
 
-        return SummaryWriter(args.tb_log_dir)
-    return None
+        writers.append(SummaryWriter(args.tb_log_dir))
+    if getattr(args, "use_wandb", False):
+        from deepinteract_tpu.training.wandb_logger import make_wandb_writer
+
+        writers.append(make_wandb_writer(
+            args.wandb_project, run_name=args.experiment_name,
+            config={k: v for k, v in vars(args).items()
+                    if isinstance(v, (int, float, str, bool, type(None)))},
+            offline=args.offline,
+        ))
+    writers = [w for w in writers if w is not None]
+    if not writers:
+        return None
+    if len(writers) == 1:
+        return writers[0]
+    from deepinteract_tpu.training.wandb_logger import FanoutWriter
+
+    return FanoutWriter(writers)

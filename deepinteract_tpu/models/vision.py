@@ -55,10 +55,11 @@ class DeepLabConfig:
     aspp_rates: Sequence[int] = (12, 24, 36)
     decoder_channels: int = 256
     high_res_channels: int = 48  # 1x1-projected skip width (DeepLab standard)
-    # Fixed at 16: ResNetEncoder implements exactly the output-stride-16
-    # stage pattern (strides 1,2,2 + dilated final stage), the reference's
-    # default (vision_modules.py:567). The reference's os-8 variant is not
-    # reproduced.
+    # 16 (reference default, vision_modules.py:567) or 8. os-16 dilates the
+    # final stage (stride 1, dilation 2); os-8 dilates the last TWO stages
+    # (dilations 2 and 4) and the decoder upsamples 2x instead of 4x to
+    # meet the 1/4-scale skip — ``make_dilated``, vision_modules.py:99-110
+    # and the os-dependent scale factor at :256.
     output_stride: int = 16
     dropout_rate: float = 0.2
 
@@ -67,8 +68,8 @@ class DeepLabConfig:
     remat: bool = False
 
     def __post_init__(self):
-        if self.output_stride != 16:
-            raise ValueError("DeepLabConfig.output_stride must be 16 (see comment)")
+        if self.output_stride not in (8, 16):
+            raise ValueError("DeepLabConfig.output_stride must be 8 or 16")
 
 
 def _pool_mask(mask: jnp.ndarray, factor: int) -> jnp.ndarray:
@@ -121,18 +122,29 @@ class SeparableConv(nn.Module):
 
 
 class BasicBlock(nn.Module):
-    """ResNet-34 basic block: two 3x3 convs + identity/projection shortcut."""
+    """ResNet-34 basic block: two 3x3 convs + identity/projection shortcut.
+
+    ``use_projection`` can force the 1x1 shortcut even at stride 1: the
+    reference's os-8 ``replace_strides_with_dilation`` keeps the downsample
+    conv (at stride 1) wherever the os-16 structure had one, so the param
+    tree — and checkpoint compatibility — is independent of output stride.
+    """
 
     features: int
     stride: int = 1
     dilation: int = 1
+    use_projection: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, mask=None):
         identity = x
         y = ConvNormAct(self.features, 3, self.stride, self.dilation)(x, mask)
         y = ConvNormAct(self.features, 3, 1, self.dilation, use_act=False)(y, mask)
-        if self.stride != 1 or x.shape[-1] != self.features:
+        project = (
+            self.use_projection if self.use_projection is not None
+            else self.stride != 1 or x.shape[-1] != self.features
+        )
+        if project:
             identity = ConvNormAct(self.features, 1, self.stride, use_act=False)(x, mask)
         return nn.relu(y + identity)
 
@@ -163,21 +175,29 @@ class ResNetEncoder(nn.Module):
         m = m4
         scale = 4
         block_cls = nn.remat(BasicBlock) if cfg.remat else BasicBlock
+        # Stage (stride, dilation) patterns (make_dilated,
+        # vision_modules.py:99-110): os-16 dilates the final stage, os-8
+        # runs the last two stages at stride 1 with dilations 2 and 4.
+        plan16 = ((1, 1), (2, 1), (2, 1), (1, 2))
+        if cfg.output_stride == 8:
+            plan = ((1, 1), (2, 1), (1, 2), (1, 4))
+        else:
+            plan = plan16
         for s, (feats, blocks) in enumerate(zip(cfg.stage_channels, cfg.stage_blocks)):
-            # Stage strides 1,2,2,(dilated 1): output stride 16 overall.
-            if s == 0:
-                stride, dilation = 1, 1
-            elif s == len(cfg.stage_channels) - 1:
-                stride, dilation = 1, 2  # make_dilated for output_stride 16
-            else:
-                stride, dilation = 2, 1
+            stride, dilation = plan[s]
             if stride == 2:
                 scale *= 2
                 m = _pool_mask(mask, scale)
             for b in range(blocks):
+                # Projection shortcuts follow the os-16 structure so both
+                # output strides share one param tree (see BasicBlock).
+                proj = (
+                    (plan16[s][0] != 1 or x.shape[-1] != feats)
+                    if b == 0 else False
+                )
                 x = block_cls(
                     feats, stride=stride if b == 0 else 1, dilation=dilation,
-                    name=f"stage{s}_block{b}",
+                    use_projection=proj, name=f"stage{s}_block{b}",
                 )(x, m)
             if s == 0:
                 skip = x  # 1/4 scale high-res tap

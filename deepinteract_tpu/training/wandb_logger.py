@@ -1,0 +1,85 @@
+"""Weights & Biases metric writer — the reference's default logger.
+
+The reference constructs a ``WandbLogger`` unless ``--offline``
+(``lit_model_train.py:169-177``) and logs scalars/images through
+Lightning. Here the Trainer's writer protocol is two methods
+(``add_scalar``/``add_image``, training/loop.py:_write_metrics and
+_log_viz_images), so W&B support is a thin adapter over ``wandb.log`` —
+usable alone or fanned out next to TensorBoard.
+
+``wandb`` is an optional dependency (absent in offline images): creation
+degrades to ``None`` with a warning rather than failing the run.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class WandbWriter:
+    """Adapter: Trainer writer protocol -> wandb.log."""
+
+    def __init__(self, project: str, run_name: Optional[str] = None,
+                 config: Optional[dict] = None, mode: Optional[str] = None):
+        import wandb  # noqa: F811 - optional dependency
+
+        self._wandb = wandb
+        kwargs = {"project": project, "config": config or {}}
+        if run_name:
+            kwargs["name"] = run_name
+        if mode:
+            kwargs["mode"] = mode  # 'offline' mirrors the reference flag
+        self.run = wandb.init(**kwargs)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._wandb.log({tag: value}, step=step)
+
+    def add_image(self, tag: str, img, step: int, dataformats: str = "HWC") -> None:
+        if dataformats == "CHW":  # wandb.Image expects HWC numpy
+            img = img.transpose(1, 2, 0)
+        self._wandb.log({tag: self._wandb.Image(img)}, step=step)
+
+    def close(self) -> None:
+        self.run.finish()
+
+
+class FanoutWriter:
+    """Broadcast writer calls to several writers (e.g. TB + W&B, the
+    reference's logger list)."""
+
+    def __init__(self, writers):
+        self.writers = [w for w in writers if w is not None]
+
+    def add_scalar(self, tag, value, step):
+        for w in self.writers:
+            w.add_scalar(tag, value, step)
+
+    def add_image(self, tag, img, step, dataformats="HWC"):
+        for w in self.writers:
+            w.add_image(tag, img, step, dataformats=dataformats)
+
+    def close(self):
+        for w in self.writers:
+            if hasattr(w, "close"):
+                w.close()
+
+
+def make_wandb_writer(project: str, run_name: Optional[str] = None,
+                      config: Optional[dict] = None,
+                      offline: bool = False) -> Optional[WandbWriter]:
+    """WandbWriter or None (+warning) when wandb is unavailable."""
+    try:
+        return WandbWriter(project, run_name, config,
+                           mode="offline" if offline else None)
+    except ImportError:
+        logger.warning(
+            "wandb is not installed; --use_wandb ignored (TensorBoard "
+            "logging via --tb_log_dir still works)"
+        )
+        return None
+    except Exception as exc:  # init/network failures must not kill training
+        logger.warning("wandb.init failed (%s); continuing without W&B", exc)
+        return None

@@ -1,0 +1,106 @@
+"""W&B writer adapter tests (wandb stubbed — not installed offline)."""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+def _install_fake_wandb():
+    calls = {"init": [], "log": [], "images": [], "finished": []}
+    mod = types.ModuleType("wandb")
+
+    class _Run:
+        def finish(self):
+            calls["finished"].append(True)
+
+    class _Image:
+        def __init__(self, arr):
+            calls["images"].append(np.asarray(arr).shape)
+
+    def init(**kwargs):
+        calls["init"].append(kwargs)
+        return _Run()
+
+    def log(payload, step=None):
+        calls["log"].append((payload, step))
+
+    mod.init, mod.log, mod.Image = init, log, _Image
+    sys.modules["wandb"] = mod
+    return calls
+
+
+def teardown_module(_):
+    sys.modules.pop("wandb", None)
+
+
+def test_wandb_writer_protocol():
+    calls = _install_fake_wandb()
+    from deepinteract_tpu.training.wandb_logger import make_wandb_writer
+
+    w = make_wandb_writer("proj", run_name="run1", config={"lr": 1e-3})
+    assert w is not None
+    assert calls["init"][0]["project"] == "proj"
+    assert calls["init"][0]["config"] == {"lr": 1e-3}
+    w.add_scalar("val_ce", 0.5, 3)
+    assert calls["log"][-1] == ({"val_ce": 0.5}, 3)
+    w.add_image("map", np.zeros((4, 5, 1), np.uint8), 2, dataformats="HWC")
+    assert calls["images"][-1] == (4, 5, 1)
+    w.add_image("map_chw", np.zeros((1, 4, 5), np.uint8), 2, dataformats="CHW")
+    assert calls["images"][-1] == (4, 5, 1)
+    w.close()
+    assert calls["finished"]
+
+
+def test_missing_wandb_degrades(monkeypatch, caplog):
+    sys.modules.pop("wandb", None)
+    import builtins
+
+    real_import = builtins.__import__
+
+    def block_wandb(name, *a, **k):
+        if name == "wandb":
+            raise ImportError("No module named 'wandb'")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", block_wandb)
+    from deepinteract_tpu.training.wandb_logger import make_wandb_writer
+
+    with caplog.at_level("WARNING"):
+        assert make_wandb_writer("proj") is None
+    assert any("wandb is not installed" in r.message for r in caplog.records)
+
+
+def test_fanout_writer():
+    from deepinteract_tpu.training.wandb_logger import FanoutWriter
+
+    class Rec:
+        def __init__(self):
+            self.scalars = []
+
+        def add_scalar(self, tag, value, step):
+            self.scalars.append((tag, value, step))
+
+        def add_image(self, *a, **k):
+            pass
+
+    a, b = Rec(), Rec()
+    fan = FanoutWriter([a, None, b])
+    fan.add_scalar("x", 1.0, 0)
+    assert a.scalars == b.scalars == [("x", 1.0, 0)]
+
+
+def test_cli_writer_composition(tmp_path):
+    _install_fake_wandb()
+    from deepinteract_tpu.cli.args import build_parser, make_metric_writer
+
+    args = build_parser("t").parse_args(
+        ["--use_wandb", "--tb_log_dir", str(tmp_path / "tb")])
+    w = make_metric_writer(args)
+    from deepinteract_tpu.training.wandb_logger import FanoutWriter
+
+    assert isinstance(w, FanoutWriter) and len(w.writers) == 2
+    w.add_scalar("loss", 1.0, 0)
+    w.close()

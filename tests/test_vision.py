@@ -40,6 +40,23 @@ class TestDeepLabDecoder:
         assert out.shape == (1, 32, 32, 2)
         assert bool(jnp.isfinite(out).all())
 
+    def test_output_stride_8_variant(self):
+        """os-8 (make_dilated stages 4+5 with dilations 2/4 and a 2x decoder
+        upsample, vision_modules.py:99-110,256): deep features at 1/8 scale,
+        same output contract."""
+        cfg8 = dataclasses.replace(TINY, output_stride=8)
+        out, variables = _run(cfg8, 32, 32)
+        assert out.shape == (1, 32, 32, 2)
+        assert bool(jnp.isfinite(out).all())
+        # os-8 and os-16 share the param-tree structure (dilation changes
+        # no shapes), so checkpoints remain interchangeable.
+        _, v16 = _run(TINY, 32, 32)
+        t8 = jax.tree_util.tree_structure(variables)
+        t16 = jax.tree_util.tree_structure(v16)
+        assert t8 == t16
+        with pytest.raises(ValueError, match="8 or 16"):
+            dataclasses.replace(TINY, output_stride=4)
+
     def test_odd_input_sizes(self):
         # The reference slices upsampled logits back to odd sizes
         # (vision_modules.py:211-217, 280-285).
