@@ -1,0 +1,212 @@
+"""Test oracle: import and execute the reference's torch modules offline.
+
+The published checkpoint and DGL are unavailable in this image, so parity
+tests import the reference's *own* module definitions from
+``/root/reference`` (read-only; nothing is copied into this repo) with the
+frameworks they never exercise at inference stubbed out, and drive the
+graph modules through a ~100-line mini-DGL: dense arrays + index_add over
+an explicit (src, dst) edge list implementing exactly the API surface the
+reference calls (``apply_edges`` UDFs, ``send_and_recv`` with
+``u_mul_e``/``copy_e``/``sum``, ``ndata``/``edata``, ``local_scope``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import types
+
+import numpy as np
+
+REFERENCE_ROOT = "/root/reference"
+HAVE_REFERENCE = os.path.isdir(os.path.join(REFERENCE_ROOT, "project", "utils"))
+
+
+def import_reference_modules():
+    """``project.utils.deepinteract_modules`` with dgl/lightning/metrics
+    stubbed and the *real* ``graph_utils``/constants imported."""
+    if "project.utils.deepinteract_modules" in sys.modules:
+        return sys.modules["project.utils.deepinteract_modules"]
+
+    def stub(name, **attrs):
+        mod = types.ModuleType(name)
+        for k, v in attrs.items():
+            setattr(mod, k, v)
+        sys.modules[name] = mod
+        return mod
+
+    import torch
+    import torch.nn as tnn
+
+    dgl = stub("dgl", DGLGraph=object)
+    # dgl.function message/reduce builders become inspectable markers the
+    # FakeDGLGraph interprets.
+    dgl.function = stub(
+        "dgl.function",
+        u_mul_e=lambda u, e, out: ("u_mul_e", u, e, out),
+        copy_e=lambda e, out: ("copy_e", e, out),
+        sum=lambda msg, out: ("sum", msg, out),
+    )
+    # dgl.udf.EdgeBatch/NodeBatch appear in UDF type annotations, which
+    # torch class bodies evaluate at import time.
+    dgl.udf = stub("dgl.udf", EdgeBatch=object, NodeBatch=object)
+    dgl.nn = stub("dgl.nn")
+    dgl.nn.pytorch = stub(
+        "dgl.nn.pytorch",
+        GraphConv=tnn.Identity,
+        pairwise_squared_distance=lambda x: torch.cdist(x, x) ** 2,
+    )
+    stub("pytorch_lightning", LightningModule=tnn.Module,
+         seed_everything=lambda *a, **k: None)
+    stub("torchmetrics", **{
+        n: (lambda *a, **k: tnn.Identity())
+        for n in ("Accuracy", "Precision", "Recall", "AUROC",
+                  "AveragePrecision", "F1Score")
+    })
+    stub("wandb")
+
+    class _Dummy:
+        def __init__(self, *a, **k):
+            pass
+
+    bio = stub("Bio")
+    bio.PDB = stub("Bio.PDB")
+    stub("Bio.PDB.PDBParser", PDBParser=_Dummy)
+    stub("Bio.PDB.Polypeptide", CaPPBuilder=_Dummy)
+
+    def get_geo_feats_from_edges(edge_feats, fi):
+        """Faithful stand-in for the reference helper (slices the edge
+        schema per FEATURE_INDICES; deepinteract_utils.py:70-76) — the full
+        deepinteract_utils module drags in atom3/Bio and cannot import."""
+        return (
+            edge_feats[:, fi["edge_dist_feats_start"]:fi["edge_dist_feats_end"]],
+            edge_feats[:, fi["edge_dir_feats_start"]:fi["edge_dir_feats_end"]],
+            edge_feats[:, fi["edge_orient_feats_start"]:fi["edge_orient_feats_end"]],
+            edge_feats[:, fi["edge_amide_angles"]],
+        )
+
+    noop = lambda *a, **k: None  # noqa: E731
+    stub(
+        "project.utils.deepinteract_utils",
+        construct_interact_tensor=noop, glorot_orthogonal=noop,
+        get_geo_feats_from_edges=get_geo_feats_from_edges,
+        construct_subsequenced_interact_tensors=noop,
+        insert_interact_tensor_logits=noop, remove_padding=noop,
+        remove_subsequenced_input_padding=noop, calculate_top_k_prec=noop,
+        calculate_top_k_recall=noop, extract_object=noop,
+    )
+    stub("project.utils.vision_modules", DeepLabV3Plus=object)
+
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)
+    import importlib
+
+    # The real message-passing UDF helpers (src_dot_dst/scaling/
+    # imp_exp_attn/out_edge_features/exp) — pure torch once dgl is stubbed.
+    importlib.import_module("project.utils.graph_utils")
+    return importlib.import_module("project.utils.deepinteract_modules")
+
+
+class _EdgeBatch:
+    """The slice of DGL's EdgeBatch API the reference UDFs touch."""
+
+    def __init__(self, graph):
+        self.src = {k: v[graph.src_ids] for k, v in graph.ndata.items()}
+        self.dst = {k: v[graph.dst_ids] for k, v in graph.ndata.items()}
+        self.data = graph.edata
+
+
+class FakeDGLGraph:
+    """Mini-DGL over an explicit (src, dst) edge list (torch tensors)."""
+
+    def __init__(self, src_ids, dst_ids, num_nodes: int):
+        import torch
+
+        self.src_ids = torch.as_tensor(np.asarray(src_ids), dtype=torch.long)
+        self.dst_ids = torch.as_tensor(np.asarray(dst_ids), dtype=torch.long)
+        self._n = int(num_nodes)
+        self.ndata = {}
+        self.edata = {}
+
+    # -- topology ----------------------------------------------------------
+    def number_of_nodes(self):
+        return self._n
+
+    num_nodes = number_of_nodes
+
+    def nodes(self):
+        import torch
+
+        return torch.arange(self._n)
+
+    def edges(self):
+        return self.src_ids, self.dst_ids
+
+    def batch_num_nodes(self):
+        import torch
+
+        return torch.tensor([self._n])
+
+    def batch_num_edges(self):
+        import torch
+
+        return torch.tensor([len(self.src_ids)])
+
+    def set_batch_num_nodes(self, *_):
+        pass
+
+    def set_batch_num_edges(self, *_):
+        pass
+
+    # -- message passing ---------------------------------------------------
+    def apply_edges(self, udf):
+        self.edata.update(udf(_EdgeBatch(self)))
+
+    def send_and_recv(self, _eids, message_fn, reduce_fn):
+        import torch
+
+        kind = message_fn[0]
+        if kind == "u_mul_e":
+            _, u, e, _out = message_fn
+            msg = self.ndata[u][self.src_ids] * self.edata[e]
+        elif kind == "copy_e":
+            _, e, _out = message_fn
+            msg = self.edata[e]
+        else:  # pragma: no cover - unknown builder means the shim is stale
+            raise NotImplementedError(kind)
+        rkind, _rmsg, rout = reduce_fn
+        assert rkind == "sum", rkind
+        out = torch.zeros((self._n,) + msg.shape[1:], dtype=msg.dtype)
+        out.index_add_(0, self.dst_ids, msg)
+        self.ndata[rout] = out
+
+    @contextlib.contextmanager
+    def local_scope(self):
+        nd, ed = dict(self.ndata), dict(self.edata)
+        try:
+            yield self
+        finally:
+            self.ndata, self.edata = nd, ed
+
+
+def fake_graph_from_raw(raw) -> FakeDGLGraph:
+    """Our featurizer's raw chain dict -> FakeDGLGraph with the reference's
+    field names; edge (i, k) has flat id i*K+k matching our dense layout
+    (data/graph.py docstring)."""
+    import torch
+
+    n, k = raw["nbr_idx"].shape
+    src = np.repeat(np.arange(n, dtype=np.int64), k)
+    dst = raw["nbr_idx"].reshape(-1).astype(np.int64)
+    g = FakeDGLGraph(src, dst, n)
+    g.ndata["f"] = torch.from_numpy(np.asarray(raw["node_feats"], np.float32))
+    g.ndata["x"] = torch.from_numpy(np.asarray(raw["coords"], np.float32))
+    e = n * k
+    g.edata["f"] = torch.from_numpy(
+        np.asarray(raw["edge_feats"], np.float32).reshape(e, -1))
+    g.edata["src_nbr_e_ids"] = torch.from_numpy(
+        np.asarray(raw["src_nbr_eids"], np.int64).reshape(e, -1))
+    g.edata["dst_nbr_e_ids"] = torch.from_numpy(
+        np.asarray(raw["dst_nbr_eids"], np.int64).reshape(e, -1))
+    return g

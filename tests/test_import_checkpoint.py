@@ -17,10 +17,6 @@ The real Zenodo checkpoint (README.md:249-253) is unreachable offline, so:
 
 from __future__ import annotations
 
-import os
-import sys
-import types
-
 import numpy as np
 import pytest
 
@@ -33,8 +29,11 @@ from deepinteract_tpu.training.import_torch import (
     synthesize_reference_state_dict,
 )
 
-REFERENCE_ROOT = "/root/reference"
-HAVE_REFERENCE = os.path.isdir(os.path.join(REFERENCE_ROOT, "project", "utils"))
+from reference_oracle import (  # noqa: E402 - test-local helper package
+    HAVE_REFERENCE,
+    import_reference_modules as _import_reference_modules,
+)
+
 torch = pytest.importorskip("torch")
 
 
@@ -154,68 +153,6 @@ class TestRoundTrip:
 # ---------------------------------------------------------------------------
 # Executed parity against the reference's own torch modules
 # ---------------------------------------------------------------------------
-
-
-def _import_reference_modules():
-    """Import ``project.utils.deepinteract_modules`` from /root/reference
-    with its DGL/Lightning/metrics dependencies stubbed (the decoder and
-    ResBlock classes under test are pure torch)."""
-    if "project.utils.deepinteract_modules" in sys.modules:
-        return sys.modules["project.utils.deepinteract_modules"]
-
-    def stub(name, **attrs):
-        mod = types.ModuleType(name)
-        for k, v in attrs.items():
-            setattr(mod, k, v)
-        sys.modules[name] = mod
-        return mod
-
-    import torch.nn as tnn
-
-    dgl = stub("dgl", DGLGraph=object)
-    dgl.function = stub("dgl.function")
-    # dgl.udf.EdgeBatch/NodeBatch appear in UDF type annotations, which
-    # torch class bodies evaluate at import time.
-    dgl.udf = stub("dgl.udf", EdgeBatch=object, NodeBatch=object)
-    dgl.nn = stub("dgl.nn")
-    dgl.nn.pytorch = stub("dgl.nn.pytorch", GraphConv=tnn.Identity)
-    stub("pytorch_lightning", LightningModule=tnn.Module,
-         seed_everything=lambda *a, **k: None)
-    stub("torchmetrics", **{
-        n: (lambda *a, **k: tnn.Identity())
-        for n in ("Accuracy", "Precision", "Recall", "AUROC",
-                  "AveragePrecision", "F1Score")
-    })
-    stub("wandb")
-
-    class _Dummy:
-        def __init__(self, *a, **k):
-            pass
-
-    bio = stub("Bio")
-    bio.PDB = stub("Bio.PDB")
-    stub("Bio.PDB.PDBParser", PDBParser=_Dummy)
-    stub("Bio.PDB.Polypeptide", CaPPBuilder=_Dummy)
-
-    noop = lambda *a, **k: None  # noqa: E731
-    stub(
-        "project.utils.deepinteract_utils",
-        construct_interact_tensor=noop, glorot_orthogonal=noop,
-        get_geo_feats_from_edges=noop,
-        construct_subsequenced_interact_tensors=noop,
-        insert_interact_tensor_logits=noop, remove_padding=noop,
-        remove_subsequenced_input_padding=noop, calculate_top_k_prec=noop,
-        calculate_top_k_recall=noop, extract_object=noop,
-    )
-    stub("project.utils.graph_utils", src_dot_dst=noop, scaling=noop,
-         imp_exp_attn=noop, out_edge_features=noop, exp=noop)
-    stub("project.utils.vision_modules", DeepLabV3Plus=object)
-
-    if REFERENCE_ROOT not in sys.path:
-        sys.path.insert(0, REFERENCE_ROOT)
-    import importlib
-
-    return importlib.import_module("project.utils.deepinteract_modules")
 
 
 needs_reference = pytest.mark.skipif(

@@ -1,0 +1,125 @@
+"""FULL-MODEL executed parity: reference torch pipeline vs ours, end to end.
+
+The strongest offline substitute for evaluating the published Zenodo
+checkpoint (VERDICT r2 item 1's done-criterion): the reference's *own*
+``DGLGeometricTransformer`` (driven through the mini-DGL shim in
+``reference_oracle``), input embedding, interaction-tensor construction
+and ``ResNet2DInputWithOptAttention`` decoder run a complete forward on a
+real featurized graph pair; the live ``state_dict()`` is converted through
+``training.import_torch``; and our flax ``DeepInteract`` must reproduce
+the final contact logits to 1e-4. This simultaneously validates
+
+* every importer mapping rule on every module class, and
+* the "reference-exact numerics" claims of the GT stack (edge init,
+  conformation module incl. the shared-norm ResBlock quirk, edge-softmax
+  scatter attention, norm placement, final node-only layer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from reference_oracle import HAVE_REFERENCE, fake_graph_from_raw, import_reference_modules
+
+torch = pytest.importorskip("torch")
+
+from deepinteract_tpu.data.graph import PairedComplex, pad_graph, stack_complexes  # noqa: E402
+from deepinteract_tpu.data.synthetic import random_backbone, random_residue_feats  # noqa: E402
+from deepinteract_tpu.models.decoder import DecoderConfig  # noqa: E402
+from deepinteract_tpu.models.geometric_transformer import GTConfig  # noqa: E402
+from deepinteract_tpu.models.model import DeepInteract, ModelConfig  # noqa: E402
+from deepinteract_tpu.training.import_torch import convert_state_dict  # noqa: E402
+
+pytestmark = pytest.mark.skipif(not HAVE_REFERENCE,
+                                reason="/root/reference not present")
+
+HIDDEN = 16
+HEADS = 2
+LIMIT = 32  # node_count_limit (embedding table size), both sides
+
+
+def _chain_raw(n, rng, origin):
+    from deepinteract_tpu.data.features import featurize_chain
+
+    bb = random_backbone(n, rng, origin=origin)
+    return featurize_chain(bb, random_residue_feats(n, rng), knn=6,
+                           geo_nbrhd_size=2, rng=rng)
+
+
+def _randomize_batchnorm_stats(module, seed):
+    g = torch.Generator().manual_seed(seed)
+    for m in module.modules():
+        if isinstance(m, torch.nn.BatchNorm1d):
+            with torch.no_grad():
+                m.running_mean.normal_(0.0, 0.5, generator=g)
+                m.running_var.uniform_(0.5, 2.0, generator=g)
+
+
+@pytest.mark.slow
+def test_full_model_logit_parity():
+    mods = import_reference_modules()
+    from project.utils.deepinteract_constants import FEATURE_INDICES
+
+    rng = np.random.default_rng(3)
+    raw1 = _chain_raw(26, rng, np.zeros(3))
+    raw2 = _chain_raw(22, rng, np.array([10.0, 0.0, 0.0]))
+    n1, n2 = 26, 22
+
+    # ---- reference side (torch, eval mode) ------------------------------
+    torch.manual_seed(0)
+    embed = torch.nn.Linear(113, HIDDEN, bias=False)
+    gnn = mods.DGLGeometricTransformer(
+        node_count_limit=LIMIT, num_hidden_channels=HIDDEN,
+        num_attention_heads=HEADS, dropout_rate=0.0, num_layers=2,
+        feature_indices=FEATURE_INDICES,
+    )
+    dec = mods.ResNet2DInputWithOptAttention(
+        num_chunks=2, init_channels=2 * HIDDEN, num_channels=HIDDEN,
+        num_classes=2, module_name="interaction",
+    )
+    _randomize_batchnorm_stats(gnn, seed=7)
+    embed.eval(), gnn.eval(), dec.eval()
+
+    def ref_leg(raw):
+        g = fake_graph_from_raw(raw)
+        g.ndata["f"] = embed(g.ndata["f"])
+        g = gnn(g)
+        return g.ndata["f"]  # [N, HIDDEN]
+
+    with torch.no_grad():
+        f1, f2 = ref_leg(raw1), ref_leg(raw2)
+        # construct_interact_tensor semantics (deepinteract_utils.py:
+        # 158-172): channels = [chain1 | chain2], chain1 broadcast along
+        # columns, chain2 along rows -> [1, 2C, N1, N2].
+        t = torch.cat(
+            [f1.T[None, :, :, None].expand(1, HIDDEN, n1, n2),
+             f2.T[None, :, None, :].expand(1, HIDDEN, n1, n2)], dim=1)
+        ref_logits = dec(t).numpy()  # [1, 2, N1, N2]
+
+    # ---- import the live weights into our model -------------------------
+    sd = {f"node_in_embedding.{k}": v.numpy() for k, v in embed.state_dict().items()}
+    sd.update({f"gnn_module.0.{k}": v.numpy() for k, v in gnn.state_dict().items()})
+    sd.update({f"interact_module.{k}": v.numpy() for k, v in dec.state_dict().items()})
+
+    cfg = ModelConfig(
+        gnn=GTConfig(num_layers=2, hidden=HIDDEN, num_heads=HEADS,
+                     dropout_rate=0.0, node_count_limit=LIMIT,
+                     attention_mode="scatter", attention_impl="jnp"),
+        decoder=DecoderConfig(num_chunks=2, num_channels=HIDDEN),
+    )
+    cx = stack_complexes([PairedComplex(
+        graph1=pad_graph(raw1, n1), graph2=pad_graph(raw2, n2),
+        examples=np.zeros((n1 * n2, 3), np.int32),
+        example_mask=np.ones(n1 * n2, bool),
+        contact_map=np.zeros((n1, n2), np.int32),
+    )])
+    variables, report = convert_state_dict(sd, cfg, cx)
+    assert not report.unconsumed
+
+    ours = DeepInteract(cfg).apply(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        cx.graph1, cx.graph2, train=False,
+    )
+    ours_nchw = np.transpose(np.asarray(ours), (0, 3, 1, 2))
+    np.testing.assert_allclose(ours_nchw, ref_logits, rtol=1e-4, atol=1e-4)
