@@ -414,6 +414,51 @@ def main() -> None:
             except Exception as exc:
                 detail[key] = {"error": str(exc).splitlines()[0][:300]}
 
+    # Optional extra shapes (DI_BENCH_EXTRA=1): the long-context tiled
+    # decoder and the DeepLabV3+ alternative — not part of the driver's
+    # budgeted run, measured on demand for BASELINE.md coverage.
+    if os.environ.get("DI_BENCH_EXTRA"):
+        def make_extra(**overrides):
+            base = ModelConfig(
+                gnn=dataclasses.replace(
+                    ModelConfig().gnn,
+                    node_count_limit=overrides.pop("node_count_limit", 2304)),
+                decoder=dataclasses.replace(
+                    ModelConfig().decoder, compute_dtype=bench_dtype),
+            )
+            return DeepInteract(dataclasses.replace(base, **overrides))
+
+        for label, mk in (
+            ("b1_p384_tiled",
+             lambda: make_extra(tile_pair_map=True, node_count_limit=4096)),
+            ("b1_p128_deeplab",
+             lambda: make_extra(interact_module_type="deeplab")
+             if bench_dtype == "float32" else None),
+        ):
+            try:
+                m = mk()
+                if m is None:
+                    detail["buckets"][label] = {
+                        "skipped": "deeplab path is float32-only"}
+                    continue
+                pad = 384 if "384" in label else 128
+                n1, n2 = (370, 350) if pad == 384 else (100, 80)
+                batch = _make_batch(1, n1, n2, pad)
+                state = create_train_state(
+                    m, batch, optim_cfg=OptimConfig(steps_per_epoch=100,
+                                                    num_epochs=50),
+                )
+                bench_bucket(m, state, batch, label, detail,
+                             remat=False, scan_k=scan_k)
+                # analytic_forward_flops models the dilated stack; for
+                # these alternative architectures it is indicative only.
+                detail["buckets"][label]["analytic_note"] = (
+                    "analytic FLOPs assume the dilated decoder")
+            except Exception as exc:
+                msg = str(exc).splitlines()[0][:300] if str(exc) else repr(exc)
+                detail["buckets"][label] = {"error": msg}
+                _log(json.dumps({label: detail["buckets"][label]}))
+
     # Eval-path throughput: the per-complex dispatch the r2 Trainer used vs
     # the batched + scanned eval (VERDICT r2 item 6). DIPS-Plus validation
     # is 3,548 complexes/epoch, so this ratio is val-epoch wall time.

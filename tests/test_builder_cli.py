@@ -153,6 +153,7 @@ class TestDownload:
 
 
 class TestLRFinder:
+    @pytest.mark.slow
     def test_sweep_and_suggestion(self):
         from deepinteract_tpu.data.graph import stack_complexes
         from deepinteract_tpu.data.synthetic import random_complex

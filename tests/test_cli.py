@@ -54,6 +54,7 @@ def test_args_round_trip():
     assert loop_cfg.num_epochs == 3
 
 
+@pytest.mark.slow
 def test_train_then_test_then_predict(dataset_root, tmp_path):
     from deepinteract_tpu.cli import predict as predict_cli
     from deepinteract_tpu.cli import test as test_cli

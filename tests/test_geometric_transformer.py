@@ -40,6 +40,7 @@ def init_and_apply(cfg, graph, train=False, seed=0):
     return out, variables
 
 
+@pytest.mark.slow
 def test_forward_shapes_and_finite(rng):
     graph = make_batch(rng)
     cfg = GTConfig(num_layers=2, dropout_rate=0.0)
@@ -52,6 +53,7 @@ def test_forward_shapes_and_finite(rng):
     assert np.abs(np.asarray(node_out)[~mask]).max() == 0.0
 
 
+@pytest.mark.slow
 def test_padding_invariance(rng):
     """The same chain padded to different bucket sizes must produce identical
     node features on the real nodes — the core static-shape correctness

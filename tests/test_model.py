@@ -1,5 +1,6 @@
 """Tests for the full siamese model and training steps."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,6 +30,7 @@ def tiny_batch(rng, batch_size=2, n1=28, n2=24, n_pad=32):
     )
 
 
+@pytest.mark.slow
 def test_model_forward_shapes(rng):
     cfg = tiny_cfg()
     batch = tiny_batch(rng)
@@ -68,6 +70,7 @@ def test_losses_agree_dense_vs_gather(rng):
     np.testing.assert_allclose(float(dense_w), float(gathered_w), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_train_step_decreases_loss(rng):
     cfg = tiny_cfg()
     batch = tiny_batch(rng, batch_size=1)

@@ -1,5 +1,6 @@
 """Multi-device tests on the virtual 8-device CPU mesh (SURVEY.md §4)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -35,6 +36,7 @@ def test_mesh_construction():
     assert mesh1.shape["data"] == 8
 
 
+@pytest.mark.slow
 def test_sharded_step_matches_single_device(rng):
     """The sharded (4 data x 2 pair) step must agree numerically with the
     plain single-device step — same params, same batch."""
@@ -65,12 +67,14 @@ def test_sharded_step_matches_single_device(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_sharded_multi_step(rng):
     """make_sharded_multi_step: K scanned steps on the mesh advance the
     state K steps and agree with K sequential sharded steps."""
@@ -134,6 +138,7 @@ def test_multihost_helpers_single_process():
     assert {n for s in tiny_shards for n in s} == set(tiny)
 
 
+@pytest.mark.slow
 def test_trainer_with_mesh_donation_and_scanned_eval(rng):
     """Trainer end-to-end on a mesh: donated sharded train steps (r2 weak
     item 7), scanned sharded eval, stacked-batch placement — history must

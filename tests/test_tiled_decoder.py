@@ -83,6 +83,7 @@ def test_tiled_matches_per_tile_direct_decode(rng):
     assert float(np.abs(np.asarray(full)[:, 50:, :, :]).sum()) == 0.0
 
 
+@pytest.mark.slow
 def test_model_long_context_end_to_end(rng):
     """A 90x70 complex (pads to 96x96 with 32-tiles -> 3x3 grid) runs the
     tiled path end-to-end with finite loss; an equal-config untiled run on a

@@ -50,6 +50,7 @@ def test_early_stopping_semantics():
     assert es2.update(1.2)  # below min_delta -> stale -> stop
 
 
+@pytest.mark.slow
 def test_fit_trains_checkpoints_and_evaluates(tmp_path, data, optim_cfg):
     model = tiny_model()
     cfg = LoopConfig(num_epochs=2, ckpt_dir=str(tmp_path / "ckpt"), log_every=0,
@@ -77,6 +78,7 @@ def test_fit_trains_checkpoints_and_evaluates(tmp_path, data, optim_cfg):
     assert int(state2.step) == 3 * len(data)
 
 
+@pytest.mark.slow
 def test_scanned_eval_matches_per_batch_eval(data, optim_cfg):
     """Batched/scanned eval (eval_batches_per_dispatch > 1) must reproduce
     the classic per-batch metrics bit-for-bit — same executable math, only
@@ -101,6 +103,7 @@ def test_scanned_eval_matches_per_batch_eval(data, optim_cfg):
                                    err_msg=key)
 
 
+@pytest.mark.slow
 def test_early_stop_fires(tmp_path, data, optim_cfg):
     model = tiny_model()
     # min_delta so large nothing ever counts as improvement.
@@ -113,6 +116,7 @@ def test_early_stop_fires(tmp_path, data, optim_cfg):
     assert len(history) == 3
 
 
+@pytest.mark.slow
 def test_fine_tune_freezes_decoder(tmp_path, data, optim_cfg):
     import jax
 
@@ -150,6 +154,7 @@ class _FakeWriter:
         self.images.append((tag, img.shape, dataformats))
 
 
+@pytest.mark.slow
 def test_swa_averages_params(data, optim_cfg):
     import jax
 
@@ -175,6 +180,7 @@ def test_swa_averages_params(data, optim_cfg):
     )
 
 
+@pytest.mark.slow
 def test_viz_images_logged(data, optim_cfg):
     model = tiny_model()
     writer = _FakeWriter()
@@ -191,6 +197,7 @@ def test_viz_images_logged(data, optim_cfg):
     assert shape == (20, 16, 1)  # unpadded [n1, n2, 1]
 
 
+@pytest.mark.slow
 def test_multi_step_matches_sequential(data, optim_cfg):
     """lax.scan multi-step == K sequential train steps (same math)."""
     import jax
@@ -223,6 +230,7 @@ def test_multi_step_matches_sequential(data, optim_cfg):
     assert int(state_b.step) == len(data)
 
 
+@pytest.mark.slow
 def test_trainer_steps_per_dispatch_equivalent(data, optim_cfg):
     """A Trainer with steps_per_dispatch>1 reproduces per-step training."""
     model = tiny_model()
@@ -238,6 +246,7 @@ def test_trainer_steps_per_dispatch_equivalent(data, optim_cfg):
     np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_model_learns_single_complex(optim_cfg):
     """Learning-capacity check: overfitting one synthetic complex must
     drive the loss well below its initial value and rank true contacts
