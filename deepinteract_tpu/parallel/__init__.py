@@ -7,13 +7,21 @@ interaction map (context parallelism over the pair dimension — the
 distributed generalization of the reference's 256x256 subsequencing tiles).
 """
 
-from deepinteract_tpu.parallel.mesh import make_mesh, shard_batch, replicate  # noqa: F401
+from deepinteract_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    replicate,
+    shard_batch,
+    shard_stacked_batch,
+)
 from deepinteract_tpu.parallel.multihost import (  # noqa: F401
+    host_local_array,
     initialize_distributed,
     is_primary_host,
     shard_filenames_for_host,
 )
 from deepinteract_tpu.parallel.train import (  # noqa: F401
+    make_sharded_eval_step,
+    make_sharded_multi_eval_step,
     make_sharded_multi_step,
     make_sharded_train_step,
 )
