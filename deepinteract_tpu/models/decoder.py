@@ -53,6 +53,17 @@ class DecoderConfig:
     # bandwidth saving is new, and 128x128 maps are not bandwidth-bound);
     # intended for larger pair maps / batch sizes.
     compute_dtype: str = "float32"
+    # Roll the base ResNet's num_chunks identical dilation cycles into one
+    # ``nn.scan`` over stacked per-chunk params instead of unrolling 56
+    # blocks into the HLO. Semantics are identical (see
+    # tests/test_decoder.py scan-parity); XLA traces/compiles ONE 4-block
+    # cycle instead of 14, cutting train-step compile time ~5-8x (the r3
+    # p256 train step took 245 s to compile, VERDICT r3 item 2). Param tree
+    # changes: ``base_resnet/chunks/block_d{d}/...`` leaves gain a leading
+    # [num_chunks] axis; ``stack_chunk_params``/``unstack_chunk_params``
+    # convert to/from the unrolled layout and the torch importer handles
+    # both. False restores the r3 unrolled tree byte-for-byte.
+    scan_chunks: bool = True
 
     @property
     def dtype(self):
@@ -160,6 +171,31 @@ class BottleneckBlock(nn.Module):
         return out
 
 
+class DilationChunk(nn.Module):
+    """One dilation cycle (the scan body when ``scan_chunks`` is on): the
+    reference repeats this exact 4-block unit ``num_chunks`` times
+    (deepinteract_modules.py:1060-1086). Returns the ``(carry, out)`` pair
+    ``nn.scan`` expects."""
+
+    channels: int
+    dilation_cycle: Sequence[int]
+    use_inorm: bool
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        # Block-granularity remat, matching the unrolled path's memory
+        # behavior: each block stores only its input and recomputes inside.
+        block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
+        for d in self.dilation_cycle:
+            x = block_cls(
+                self.channels, d, self.use_inorm, self.dtype,
+                name=f"block_d{d}",
+            )(x, mask)
+        return x, None
+
+
 class DilatedResNet(nn.Module):
     """num_chunks x dilation_cycle bottleneck blocks (+2 optional extra
     blocks) with optional initial 1x1 projection
@@ -172,6 +208,7 @@ class DilatedResNet(nn.Module):
     initial_projection: bool = False
     extra_blocks: bool = False
     remat: bool = False
+    scan_chunks: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -181,12 +218,28 @@ class DilatedResNet(nn.Module):
         block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
         if self.initial_projection:
             x = nn.Conv(self.channels, (1, 1), dtype=self.dtype, name="init_proj")(x)
-        for i in range(self.num_chunks):
-            for d in self.dilation_cycle:
-                x = block_cls(
-                    self.channels, d, self.use_inorm, self.dtype,
-                    name=f"block_{i}_{d}",
-                )(x, mask)
+        if self.scan_chunks and self.num_chunks > 1:
+            # Compile ONE cycle, run it num_chunks times: params stack on a
+            # leading [num_chunks] axis under 'chunks/'. ``in_axes=
+            # nn.broadcast`` feeds the same mask to every iteration.
+            scan = nn.scan(
+                DilationChunk,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.num_chunks,
+                in_axes=nn.broadcast,
+            )
+            x, _ = scan(
+                self.channels, tuple(self.dilation_cycle), self.use_inorm,
+                self.remat, self.dtype, name="chunks",
+            )(x, mask)
+        else:
+            for i in range(self.num_chunks):
+                for d in self.dilation_cycle:
+                    x = block_cls(
+                        self.channels, d, self.use_inorm, self.dtype,
+                        name=f"block_{i}_{d}",
+                    )(x, mask)
         if self.extra_blocks:
             for i in range(2):
                 x = block_cls(
@@ -271,7 +324,7 @@ class InteractionDecoder(nn.Module):
             DilatedResNet(
                 cfg.num_channels, cfg.num_chunks, cfg.dilation_cycle,
                 use_inorm=True, initial_projection=True, remat=cfg.remat,
-                dtype=dt, name="base_resnet",
+                scan_chunks=cfg.scan_chunks, dtype=dt, name="base_resnet",
             )(x, mask)
         )
         if cfg.use_attention:
@@ -295,6 +348,8 @@ class InteractionDecoder(nn.Module):
                 dtype=dt, name="mha2d_2",
             )(x, mask, train))
 
+        # phase2 (1 chunk + 2 extra blocks) stays unrolled: scanning a
+        # length-1 cycle would change its tree for no compile saving.
         # Positive-class bias -7 => initial positive probability ~0.001
         # (reference reset_parameters, deepinteract_modules.py:1219-1226).
         def final_bias(key, shape, dtype=jnp.float32):
@@ -307,3 +362,48 @@ class InteractionDecoder(nn.Module):
         if mask is not None:
             logits = logits * mask[..., None]
         return logits
+
+
+# ---------------------------------------------------------------------------
+# Param-tree conversion between the unrolled (r3 / torch-import natural) and
+# scanned (stacked) base-ResNet layouts. Only 'base_resnet' differs; both
+# directions are exact (stack/unstack of the same leaves).
+# ---------------------------------------------------------------------------
+
+
+def stack_chunk_params(decoder_params, num_chunks: int,
+                       dilation_cycle: Sequence[int] = (1, 2, 4, 8)):
+    """Unrolled decoder subtree (``base_resnet/block_{i}_{d}/...``) ->
+    scanned layout (``base_resnet/chunks/block_d{d}/...`` with a leading
+    [num_chunks] axis on every leaf)."""
+    import jax
+
+    out = dict(decoder_params)
+    base = dict(out["base_resnet"])
+    chunks: dict = {}
+    for d in dilation_cycle:
+        per_chunk = [base.pop(f"block_{i}_{d}") for i in range(num_chunks)]
+        chunks[f"block_d{d}"] = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves, axis=0), *per_chunk
+        )
+    base["chunks"] = chunks
+    out["base_resnet"] = base
+    return out
+
+
+def unstack_chunk_params(decoder_params, num_chunks: int,
+                         dilation_cycle: Sequence[int] = (1, 2, 4, 8)):
+    """Inverse of :func:`stack_chunk_params`."""
+    import jax
+
+    out = dict(decoder_params)
+    base = dict(out["base_resnet"])
+    chunks = base.pop("chunks")
+    for d in dilation_cycle:
+        stacked = chunks[f"block_d{d}"]
+        for i in range(num_chunks):
+            base[f"block_{i}_{d}"] = jax.tree_util.tree_map(
+                lambda leaf, _i=i: leaf[_i], stacked
+            )
+    out["base_resnet"] = base
+    return out

@@ -75,11 +75,16 @@ _INVERSE = {_t_linear: _t_linear, _t_conv: lambda w: np.transpose(w, (3, 2, 0, 1
 @dataclasses.dataclass(frozen=True)
 class _Rule:
     """One flax leaf's source: reference key, layout transform, and any
-    duplicate reference keys that alias the same tensor (shared norms)."""
+    duplicate reference keys that alias the same tensor (shared norms).
+
+    ``stack > 0`` marks a scanned-decoder leaf (``scan_chunks``): ``ref_key``
+    is then a template containing ``{i}`` and the flax leaf is the
+    [stack, ...] stack of the ``stack`` per-chunk reference tensors."""
 
     ref_key: str
     transform: Callable[[np.ndarray], np.ndarray]
     aliases: Tuple[str, ...] = ()
+    stack: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -164,7 +169,22 @@ def _map_gt_layer(idx: int, rest: Tuple[str, ...], collection: str,
     raise KeyError(f"unmapped GT-layer path: {sub}/{'/'.join(rest)}")
 
 
-def _map_decoder(rest: Tuple[str, ...]) -> _Rule:
+def _unit_rule(stem: str, unit: str, tail: Tuple[str, ...], leaf: str,
+               stack: int = 0) -> _Rule:
+    """Map one bottleneck-block sub-unit (conv/inorm/se) under ``stem``."""
+    if unit.startswith("conv2d_"):
+        rule = _conv_leaf(f"{stem}_{unit}", leaf)
+    elif unit.startswith("inorm_"):
+        rule = _norm_leaf(f"{stem}_{unit}", leaf, "params")
+    elif unit == "se_block":
+        lin = {"Dense_0": "linear1", "Dense_1": "linear2"}[tail[0]]
+        rule = _dense_leaf(f"{stem}_se_block.{lin}", leaf)
+    else:
+        raise KeyError(f"unmapped block unit: {stem}/{unit}")
+    return dataclasses.replace(rule, stack=stack) if stack else rule
+
+
+def _map_decoder(rest: Tuple[str, ...], num_chunks: int = 14) -> _Rule:
     base = "interact_module"
     sub = rest[0]
     leaf = rest[-1]
@@ -183,25 +203,25 @@ def _map_decoder(rest: Tuple[str, ...]) -> _Rule:
         if child == "init_proj":
             prefix = f"{base}.{sub}.resnet_{mod}_init_proj"
             return _conv_leaf(prefix, leaf)
+        if child == "chunks":
+            # Scanned layout (DecoderConfig.scan_chunks): one flax leaf
+            # stacks the num_chunks per-chunk reference tensors; '{i}' in
+            # the template is the chunk index.
+            d = rest[2].rsplit("d", 1)[1]  # block_d{d}
+            stem = f"{base}.{sub}.resnet_{mod}_{{i}}_{d}"
+            return _unit_rule(stem, rest[3], rest[4:], leaf, stack=num_chunks)
         if child.startswith("extra_block_"):
             i = child.rsplit("_", 1)[1]
             stem = f"{base}.{sub}.resnet_{mod}_extra{i}"
         else:  # block_{i}_{d}
             _, i, d = child.split("_")
             stem = f"{base}.{sub}.resnet_{mod}_{i}_{d}"
-        unit = rest[2]
-        if unit.startswith("conv2d_"):
-            return _conv_leaf(f"{stem}_{unit}", leaf)
-        if unit.startswith("inorm_"):
-            return _norm_leaf(f"{stem}_{unit}", leaf, "params")
-        if unit == "se_block":
-            lin = {"Dense_0": "linear1", "Dense_1": "linear2"}[rest[3]]
-            return _dense_leaf(f"{stem}_se_block.{lin}", leaf)
+        return _unit_rule(stem, rest[2], rest[3:], leaf)
     raise KeyError(f"unmapped decoder path: {'/'.join(rest)}")
 
 
 def map_flax_path(collection: str, path: Tuple[str, ...], num_layers: int,
-                  norm_type: str = "batch") -> _Rule:
+                  norm_type: str = "batch", num_chunks: int = 14) -> _Rule:
     """Map one flax leaf path (without the collection prefix) to its
     reference state-dict source."""
     head = path[0]
@@ -230,7 +250,7 @@ def map_flax_path(collection: str, path: Tuple[str, ...], num_layers: int,
             idx = int(sub.rsplit("_", 1)[1])
             return _map_gt_layer(idx, path[2:], collection, norm_type)
     if head == "decoder":
-        return _map_decoder(path[1:])
+        return _map_decoder(path[1:], num_chunks)
     raise KeyError(f"unmapped flax path: {collection}/{'/'.join(path)}")
 
 
@@ -298,12 +318,33 @@ def convert_state_dict(
     num_layers = model_cfg.gnn.num_layers
     norm_type = model_cfg.gnn.norm_type
 
+    num_chunks = model_cfg.decoder.num_chunks
+
     out: Dict[str, Any] = {}
     consumed: Dict[str, str] = {}
     missing: List[str] = []
     for collection in ("params", "batch_stats"):
         for path, leaf in _iter_leaf_paths(abstract.get(collection, {})):
-            rule = map_flax_path(collection, path, num_layers, norm_type)
+            rule = map_flax_path(collection, path, num_layers, norm_type,
+                                 num_chunks)
+            if rule.stack:
+                # Scanned decoder leaf: stack the per-chunk reference tensors.
+                keys = [rule.ref_key.format(i=i) for i in range(rule.stack)]
+                absent = [k for k in keys if k not in sd]
+                if absent:
+                    missing.extend(absent)
+                    continue
+                value = np.stack([rule.transform(sd[k]) for k in keys])
+                if tuple(value.shape) != tuple(leaf.shape):
+                    raise ValueError(
+                        f"shape mismatch for stacked {rule.ref_key} -> "
+                        f"{collection}/{'/'.join(path)}: got {value.shape}, "
+                        f"expected {tuple(leaf.shape)}"
+                    )
+                _set_leaf(out, (collection,) + path, value.astype(np.float32))
+                for k in keys:
+                    consumed[k] = "/".join(path)
+                continue
             if rule.ref_key not in sd:
                 missing.append(rule.ref_key)
                 continue
@@ -359,17 +400,28 @@ def synthesize_reference_state_dict(
     for collection in ("params", "batch_stats"):
         for path, leaf in _iter_leaf_paths(abstract.get(collection, {})):
             rule = map_flax_path(collection, path, model_cfg.gnn.num_layers,
-                                 model_cfg.gnn.norm_type)
+                                 model_cfg.gnn.norm_type,
+                                 model_cfg.decoder.num_chunks)
             if rule.ref_key in sd:
                 continue  # shared (aliased) tensors emitted once below
             flax_value = rng.standard_normal(leaf.shape).astype(np.float32)
             if len(leaf.shape) >= 2:
                 # realistic magnitude (fan-in scaled) so a forward pass with
-                # these synthetic weights stays finite through 60+ layers
-                fan_in = int(np.prod(leaf.shape[:-1]))
+                # these synthetic weights stays finite through 60+ layers.
+                # The stacked chunk axis is not part of the fan-in.
+                fan_shape = leaf.shape[1:-1] if rule.stack else leaf.shape[:-1]
+                fan_in = int(np.prod(fan_shape))
                 flax_value *= 1.0 / np.sqrt(max(fan_in, 1))
             if path[-1] == "var":  # running variances must be positive
                 flax_value = np.abs(flax_value) + 0.5
+            if rule.stack:
+                # One reference tensor per chunk (the flax leaf's leading
+                # axis).
+                for i in range(rule.stack):
+                    sd[rule.ref_key.format(i=i)] = np.ascontiguousarray(
+                        _INVERSE[rule.transform](flax_value[i])
+                    )
+                continue
             ref_value = _INVERSE[rule.transform](flax_value)
             sd[rule.ref_key] = np.ascontiguousarray(ref_value)
             for alias in rule.aliases:

@@ -243,11 +243,17 @@ def test_reference_decoder_executed_parity():
     params: dict = {}
     consumed = set()
     for path, leaf in _iter_leaf_paths(dict(abstract)["params"]):
-        rule = map_flax_path("params", ("decoder",) + path, num_layers=2)
-        value = rule.transform(sd[rule.ref_key])
+        rule = map_flax_path("params", ("decoder",) + path, num_layers=2,
+                             num_chunks=2)
+        if rule.stack:  # scanned base-ResNet leaf: stack per-chunk tensors
+            keys = [rule.ref_key.format(i=i) for i in range(rule.stack)]
+            value = np.stack([rule.transform(sd[k]) for k in keys])
+            consumed.update(keys)
+        else:
+            value = rule.transform(sd[rule.ref_key])
+            consumed.add(rule.ref_key)
         assert tuple(value.shape) == tuple(leaf.shape), (path, value.shape, leaf.shape)
         _set_leaf(params, path, value)
-        consumed.add(rule.ref_key)
     assert consumed == set(sd), sorted(set(sd) - consumed)[:5]
 
     ours = dec.apply({"params": params}, x_nhwc, None, train=False)

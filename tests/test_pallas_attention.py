@@ -85,3 +85,44 @@ def test_forward_parity_blocked_192(rng):
     h_ker, e_ker = edge_attention_pallas(q, k, v, pe, nbr, mask, True)
     np.testing.assert_allclose(np.asarray(h_ker), np.asarray(h_ref), rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(e_ker), np.asarray(e_ref), rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_parity_blocked_256(rng):
+    """Fused backward in the multi-edge-block grid (n > 128): gradients must
+    match the jnp VJP at tolerance (accumulation order differs per block)."""
+    q, k, v, pe, nbr, mask = _jnp_inputs(rng, b=1, n=256, k=4, h=2, d=8)
+
+    def loss_ref(q_, k_, v_, pe_):
+        h, e = edge_attention(q_, k_, v_, pe_, nbr, mask, mode="scatter")
+        return (h ** 2).sum() + (e * 0.3).sum()
+
+    def loss_ker(q_, k_, v_, pe_):
+        h, e = edge_attention_pallas(q_, k_, v_, pe_, nbr, mask, True)
+        return (h ** 2).sum() + (e * 0.3).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, pe)
+    g_ker = jax.grad(loss_ker, argnums=(0, 1, 2, 3))(q, k, v, pe)
+    for a, b in zip(g_ker, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_gradient_parity_clip_saturation(rng):
+    """Large-magnitude inputs drive both clips (score +-5, logit-sum +-5)
+    into saturation; the fused backward's clip masks must zero exactly the
+    gradients the jnp VJP zeroes."""
+    q, k, v, pe, nbr, mask = _raw_inputs(rng, b=1, n=32, k=6, h=2, d=8)
+    q, k = q * 4.0, k * 4.0  # push many |scores| past the clip
+    q, k, v, pe, nbr, mask = map(jnp.asarray, (q, k, v, pe, nbr, mask))
+
+    def loss_ref(q_, k_, v_, pe_):
+        h, e = edge_attention(q_, k_, v_, pe_, nbr, mask, mode="scatter")
+        return (h ** 2).sum() + (e * 0.3).sum()
+
+    def loss_ker(q_, k_, v_, pe_):
+        h, e = edge_attention_pallas(q_, k_, v_, pe_, nbr, mask, True)
+        return (h ** 2).sum() + (e * 0.3).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, pe)
+    g_ker = jax.grad(loss_ker, argnums=(0, 1, 2, 3))(q, k, v, pe)
+    for a, b in zip(g_ker, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
