@@ -67,6 +67,11 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                    help="rematerialize decoder blocks in backward (cuts "
                         "train-step HBM ~4x; required for batch 8 at "
                         "128-pad on a 16G chip)")
+    g.add_argument("--unrolled_decoder", action="store_true",
+                   help="unroll the decoder's base-ResNet chunks instead "
+                        "of nn.scan (the pre-r4 param layout; needed to "
+                        "load checkpoints saved with the unrolled tree — "
+                        "scan compiles ~5x faster, same numerics)")
     g.add_argument("--dropout_rate", type=float, default=0.2)
     g.add_argument("--attention_mode", choices=("scatter", "gather"), default="scatter",
                    help="scatter = reference-exact edge softmax; gather = "
@@ -138,6 +143,13 @@ def add_logging_args(p: argparse.ArgumentParser) -> None:
                         "lit_model_train.py:169-177); degrades with a "
                         "warning when wandb is unavailable")
     g.add_argument("--wandb_project", type=str, default="DeepInteract-TPU")
+    g.add_argument("--wandb_entity", type=str, default=None,
+                   help="W&B entity for artifact restore (reference "
+                        "--entity)")
+    g.add_argument("--wandb_run_id", type=str, default=None,
+                   help="restore the model-<run_id>:best checkpoint "
+                        "artifact when no local checkpoint exists "
+                        "(reference lit_model_test.py:121-130)")
     g.add_argument("--offline", action="store_true",
                    help="wandb offline mode (reference --offline flag)")
     g.add_argument("--profile_dir", type=str, default=None,
@@ -174,6 +186,7 @@ def configs_from_args(
         dropout_rate=args.dropout_rate,
         remat=args.remat,
         compute_dtype=args.compute_dtype,
+        scan_chunks=not args.unrolled_decoder,
     )
     from deepinteract_tpu.models.vision import DeepLabConfig
 
@@ -226,6 +239,19 @@ def make_mesh_from_args(args) -> Optional[object]:
     return None
 
 
+def default_experiment_name(args) -> str:
+    """The reference's run-naming convention when ``--experiment_name`` is
+    unset (lit_model_train.py:93-98): LitGINI-b{batch}-gl{gnn_layers}-
+    n{hidden}-e{hidden}-il{interact_layers}-i{interact_hidden}."""
+    if getattr(args, "experiment_name", None):
+        return args.experiment_name
+    return (f"LitGINI-b{args.batch_size}-gl{args.num_gnn_layers}"
+            f"-n{args.num_gnn_hidden_channels}"
+            f"-e{args.num_gnn_hidden_channels}"
+            f"-il{args.num_interact_layers}"
+            f"-i{args.num_interact_hidden_channels}")
+
+
 def make_metric_writer(args):
     writers = []
     if getattr(args, "tb_log_dir", None):
@@ -236,7 +262,7 @@ def make_metric_writer(args):
         from deepinteract_tpu.training.wandb_logger import make_wandb_writer
 
         writers.append(make_wandb_writer(
-            args.wandb_project, run_name=args.experiment_name,
+            args.wandb_project, run_name=default_experiment_name(args),
             config={k: v for k, v in vars(args).items()
                     if isinstance(v, (int, float, str, bool, type(None)))},
             offline=args.offline,

@@ -27,22 +27,46 @@ import sys
 import numpy as np
 
 
-def load_reference_checkpoint(path: str):
+def load_reference_checkpoint(path: str, unsafe_load: bool = False):
     """Load a checkpoint file into (state_dict of np arrays, hparams dict).
 
     Supports Lightning ``.ckpt``/torch ``.pt`` (needs torch, present in
     this image as CPU-only) and ``.npz``/pickled plain dicts of arrays.
+
+    Checkpoints come from an external source (Zenodo), so the default path
+    is ``torch.load(weights_only=True)``, which cannot execute arbitrary
+    pickle code. Lightning checkpoints whose ``hyper_parameters`` blob
+    holds non-tensor container types may need ``unsafe_load=True``
+    (``--unsafe-load``) — only use it on checkpoints you trust.
     """
     if path.endswith(".npz"):
         data = dict(np.load(path))
         return data, {}
     try:
         import torch
-
-        blob = torch.load(path, map_location="cpu", weights_only=False)
     except ModuleNotFoundError:
+        if not unsafe_load:
+            raise SystemExit(
+                "torch is unavailable and the raw-pickle fallback executes "
+                "arbitrary code from the file; re-run with --unsafe-load "
+                "only if you trust this checkpoint"
+            )
         with open(path, "rb") as fh:
             blob = pickle.load(fh)
+    else:
+        if unsafe_load:
+            print("WARNING: --unsafe-load executes pickled code from the "
+                  "checkpoint; only use on files you trust", file=sys.stderr)
+            blob = torch.load(path, map_location="cpu", weights_only=False)
+        else:
+            try:
+                blob = torch.load(path, map_location="cpu", weights_only=True)
+            except Exception as exc:
+                raise SystemExit(
+                    f"safe (weights_only) torch.load failed: {exc}\n"
+                    "If the checkpoint stores custom hyper_parameter types, "
+                    "re-run with --unsafe-load (trusted files only)."
+                )
     if isinstance(blob, dict) and "state_dict" in blob:
         sd, hparams = blob["state_dict"], dict(blob.get("hyper_parameters") or {})
     else:
@@ -104,9 +128,13 @@ def main(argv=None) -> int:
                         help="orbax checkpoint directory to create")
     parser.add_argument("--no_hparams", action="store_true",
                         help="ignore the checkpoint's hyper_parameters blob")
+    parser.add_argument("--unsafe-load", action="store_true",
+                        help="allow full (code-executing) pickle load for "
+                             "checkpoints the safe weights_only path rejects; "
+                             "trusted files only")
     args = parser.parse_args(argv)
 
-    sd, hparams = load_reference_checkpoint(args.ckpt)
+    sd, hparams = load_reference_checkpoint(args.ckpt, args.unsafe_load)
     if not args.no_hparams:
         apply_hparams(args, hparams, parser)
 

@@ -10,7 +10,51 @@ from __future__ import annotations
 
 import sys
 
+import os
+
 from deepinteract_tpu.cli.args import build_parser, configs_from_args, make_mesh_from_args
+
+
+def resolve_checkpoint_source(args, download=None) -> str:
+    """Local checkpoint dir, or — when it does not exist and
+    ``--wandb_run_id`` is given — the downloaded ``model-<run_id>:best``
+    W&B artifact (reference restore order, lit_model_test.py:121-130).
+    ``download`` is injectable for tests."""
+    ckpt_dir = args.ckpt_name or args.ckpt_dir
+    if ckpt_dir and os.path.exists(ckpt_dir):
+        return ckpt_dir
+    run_id = getattr(args, "wandb_run_id", None)
+    if run_id:
+        if download is None:
+            from deepinteract_tpu.training.wandb_logger import (
+                download_checkpoint_artifact,
+            )
+
+            download = download_checkpoint_artifact
+        art_dir = download(args.wandb_project, run_id,
+                           entity=getattr(args, "wandb_entity", None))
+        if art_dir:
+            return art_dir
+        raise SystemExit(
+            f"no local checkpoint at {ckpt_dir!r} and the W&B artifact "
+            f"model-{run_id}:best could not be downloaded"
+        )
+    if not ckpt_dir:
+        raise SystemExit("provide --ckpt_name/--ckpt_dir or --wandb_run_id")
+    return ckpt_dir
+
+
+def _find_torch_checkpoint(path: str):
+    """Path of a reference torch/Lightning checkpoint inside ``path`` (the
+    layout of W&B model artifacts: <dir>/model.ckpt), else None."""
+    if os.path.isfile(path) and path.endswith((".ckpt", ".pt")):
+        return path
+    if os.path.isdir(path):
+        for name in ("model.ckpt", "model.pt"):
+            cand = os.path.join(path, name)
+            if os.path.isfile(cand):
+                return cand
+    return None
 
 
 def main(argv=None) -> int:
@@ -18,6 +62,10 @@ def main(argv=None) -> int:
     parser.add_argument("--csv_out", type=str, default=None,
                         help="per-target CSV path (default mirrors the "
                              "reference naming, deepinteract_modules.py:2139-2143)")
+    parser.add_argument("--unsafe-load", action="store_true",
+                        help="allow full (code-executing) pickle load for "
+                             "torch checkpoints the safe weights_only path "
+                             "rejects; trusted files only")
     args = parser.parse_args(argv)
 
     from deepinteract_tpu.data.datasets import PICPDataModule
@@ -41,17 +89,32 @@ def main(argv=None) -> int:
 
     model = DeepInteract(model_cfg)
     trainer = Trainer(model, loop_cfg, optim_cfg, mesh=make_mesh_from_args(args))
-    state = trainer.init_state(next(iter(test_loader)))
+    example = next(iter(test_loader))
+    state = trainer.init_state(example)
 
-    ckpt_dir = args.ckpt_name or args.ckpt_dir
-    ckpt = Checkpointer(CheckpointConfig(directory=ckpt_dir,
-                                         metric_to_track=args.metric_to_track))
-    tree = state_to_tree(state)
-    restored = ckpt.restore({"params": tree["params"],
-                             "batch_stats": tree["batch_stats"]},
-                            which="best", partial=True)
-    ckpt.close()
-    state = state.replace(params=restored["params"], batch_stats=restored["batch_stats"])
+    ckpt_dir = resolve_checkpoint_source(args)
+    torch_ckpt = _find_torch_checkpoint(ckpt_dir)
+    if torch_ckpt is not None:
+        # A reference-layout artifact (Lightning's model.ckpt): route
+        # through the torch importer instead of orbax.
+        from deepinteract_tpu.cli.import_checkpoint import load_reference_checkpoint
+        from deepinteract_tpu.training.import_torch import convert_state_dict
+
+        sd, _ = load_reference_checkpoint(torch_ckpt, args.unsafe_load)
+        variables, report = convert_state_dict(sd, model_cfg, example)
+        print(f"imported torch checkpoint {torch_ckpt}: {report.summary()}")
+        state = state.replace(params=variables["params"],
+                              batch_stats=variables["batch_stats"])
+    else:
+        ckpt = Checkpointer(CheckpointConfig(directory=ckpt_dir,
+                                             metric_to_track=args.metric_to_track))
+        tree = state_to_tree(state)
+        restored = ckpt.restore({"params": tree["params"],
+                                 "batch_stats": tree["batch_stats"]},
+                                which="best", partial=True)
+        ckpt.close()
+        state = state.replace(params=restored["params"],
+                              batch_stats=restored["batch_stats"])
 
     # Reference CSV naming (deepinteract_modules.py:2139-2143).
     if args.csv_out:

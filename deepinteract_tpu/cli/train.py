@@ -136,6 +136,18 @@ def main(argv=None) -> int:
             state, train_loader, val_data=val_loader, resume=args.resume
         )
 
+    # Publish the checkpoint directory as this run's model artifact
+    # (Lightning WandbLogger log_model convention; restored by cli.test
+    # --wandb_run_id, reference lit_model_test.py:121-130). No-op for
+    # writers without artifact support (TensorBoard-only, offline).
+    writer = trainer.metric_writer
+    if (is_primary_host() and writer is not None and args.ckpt_dir
+            and hasattr(writer, "log_checkpoint_artifact")):
+        try:
+            writer.log_checkpoint_artifact(args.ckpt_dir)
+        except Exception as exc:  # artifact upload must not fail the run
+            print(f"checkpoint artifact upload failed: {exc}")
+
     test_metrics = trainer.evaluate(
         state, test_loader, stage="test", targets=test_loader.targets(),
         csv_path="test_top_metrics.csv" if is_primary_host() else None,

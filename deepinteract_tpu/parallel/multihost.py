@@ -46,15 +46,21 @@ def initialize_distributed(
     """
     import os
 
-    from jax._src import distributed as _dist
-
     explicit = any(
         v is not None for v in (coordinator_address, num_processes, process_id)
     )
     # Idempotency via the distributed client itself: process_count() would
     # initialize the XLA backend and make a later initialize() impossible.
-    if getattr(_dist.global_state, "client", None) is not None:
-        return jax.process_index()  # already initialized
+    # jax._src is internal and may move across JAX upgrades — it is a
+    # best-effort fast path only; the public fallback below catches the
+    # "already initialized" RuntimeError from jax.distributed.initialize.
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return jax.process_index()  # already initialized
+    except (ImportError, AttributeError):  # pragma: no cover - jax version
+        pass
     if explicit or os.environ.get("JAX_COORDINATOR_ADDRESS"):
         # A deliberate multi-process run. CPU backends need a collectives
         # implementation AND the platform pinned through jax.config (the
@@ -77,7 +83,9 @@ def initialize_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except (RuntimeError, ValueError):
+    except (RuntimeError, ValueError) as exc:
+        if "already initialized" in str(exc).lower():
+            return jax.process_index()  # idempotent re-entry (public path)
         if explicit:
             # The caller asked for a specific topology; degrading to
             # single-process here would silently split-brain the run.

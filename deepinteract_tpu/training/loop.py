@@ -279,9 +279,40 @@ class Trainer:
 
         # Multi-host note: every host feeds the same complexes, so this
         # host's local shard of the global outputs is exactly what
-        # host_batch holds — metrics come out identical on all hosts.
+        # host_batch holds — metrics come out identical on all hosts. That
+        # agreement is a *correctness precondition* (divergent metrics feed
+        # EarlyStopping, and disagreeing hosts deadlock on the next
+        # collective), so it is asserted on the first batch rather than
+        # left to convention in cli/train.py.
+        first_checked = False
+
+        def check_host_agreement(host_batch):
+            nonlocal first_checked
+            if first_checked or jax.process_count() <= 1:
+                return
+            first_checked = True
+            from jax.experimental import multihost_utils
+
+            cm = np.asarray(host_batch.contact_map)
+            fingerprint = np.asarray(
+                [float(np.asarray(host_batch.graph1.num_nodes).sum()),
+                 float(np.asarray(host_batch.graph2.num_nodes).sum()),
+                 float(cm.shape[0]), float(cm.shape[1]), float(cm.shape[2]),
+                 float(cm.sum())],
+                dtype=np.float32,
+            )
+            multihost_utils.assert_equal(
+                fingerprint,
+                fail_message=(
+                    "evaluate: hosts fed different first val batches — the "
+                    "val loader must be identical (unsharded) on every host"
+                ),
+            )
+
         k = max(1, self.cfg.eval_batches_per_dispatch)
         for run in _shape_runs(_iter_data(val_data, 0), k):
+            if run:
+                check_host_agreement(run[0])
             if len(run) < max(k, 2):
                 for hb in run:
                     out = self._eval_step(state, self._device_batch(hb))
@@ -423,7 +454,17 @@ class Trainer:
 
         if cfg.swa and swa_params is not None:
             self.log(f"SWA: averaged {swa_count} epoch snapshot(s) into final params")
-            state = state.replace(params=jax.device_put(swa_params))
+            if self.mesh is not None:
+                # Mesh runs: bare device_put would commit the averaged
+                # params to one local device and clash with mesh-sharded
+                # batches in the stats refresh below (multi-host would mix
+                # host-local params with global batch arrays). Re-replicate
+                # over the mesh like the initial state placement.
+                from deepinteract_tpu.parallel.mesh import replicate
+
+                state = state.replace(params=replicate(swa_params, self.mesh))
+            else:
+                state = state.replace(params=jax.device_put(swa_params))
             # Batch-norm statistics were accumulated for the last-epoch
             # weights; refresh them for the averaged weights (Lightning's
             # StochasticWeightAveraging does the same BN-update pass).

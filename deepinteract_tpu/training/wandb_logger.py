@@ -42,6 +42,16 @@ class WandbWriter:
             img = img.transpose(1, 2, 0)
         self._wandb.log({tag: self._wandb.Image(img)}, step=step)
 
+    def log_checkpoint_artifact(self, ckpt_dir: str,
+                                aliases=("best", "latest")) -> None:
+        """Upload a checkpoint directory as the run's ``model-<run_id>``
+        artifact — the convention Lightning's WandbLogger(log_model=True)
+        uses and the reference's test CLI restores by
+        (``model-{run_id}:best``, lit_model_test.py:121-124)."""
+        artifact = self._wandb.Artifact(f"model-{self.run.id}", type="model")
+        artifact.add_dir(ckpt_dir)
+        self.run.log_artifact(artifact, aliases=list(aliases))
+
     def close(self) -> None:
         self.run.finish()
 
@@ -61,10 +71,42 @@ class FanoutWriter:
         for w in self.writers:
             w.add_image(tag, img, step, dataformats=dataformats)
 
+    def log_checkpoint_artifact(self, ckpt_dir, aliases=("best", "latest")):
+        for w in self.writers:
+            if hasattr(w, "log_checkpoint_artifact"):
+                w.log_checkpoint_artifact(ckpt_dir, aliases=aliases)
+
     def close(self):
         for w in self.writers:
             if hasattr(w, "close"):
                 w.close()
+
+
+def download_checkpoint_artifact(project: str, run_id: str,
+                                 entity: Optional[str] = None,
+                                 alias: str = "best") -> Optional[str]:
+    """Download the ``model-<run_id>:<alias>`` checkpoint artifact and
+    return its local directory, or None when wandb/network is unavailable
+    (offline-degradable, like every other W&B touchpoint here).
+
+    Reference: ``lit_model_test.py:121-130`` restores
+    ``{entity}/{project}/model-{run_id}:best`` before evaluating.
+    """
+    ref = f"model-{run_id}:{alias}"
+    if project:
+        ref = f"{project}/{ref}"
+    if entity:
+        ref = f"{entity}/{ref}"
+    try:
+        import wandb
+
+        return wandb.Api().artifact(ref, type="model").download()
+    except ImportError:
+        logger.warning("wandb is not installed; cannot restore artifact %s", ref)
+        return None
+    except Exception as exc:
+        logger.warning("artifact restore failed for %s (%s)", ref, exc)
+        return None
 
 
 def make_wandb_writer(project: str, run_name: Optional[str] = None,

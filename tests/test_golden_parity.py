@@ -1,0 +1,67 @@
+"""Torch-free full-model parity against the checked-in golden fixture.
+
+``tests/golden/full_model_parity.npz`` (generated once by
+``tools/make_golden_fixture.py`` from the live reference + torch) holds the
+reference pipeline's state_dict, a real featurized input pair, and the
+reference's output contact logits. This test re-imports those weights
+through ``training.import_torch`` and runs our flax ``DeepInteract``
+forward — full-model executed parity in a bare environment (no torch, no
+/root/reference), every fast-tier run (VERDICT r3 item 7). The live-oracle
+variant (tests/test_reference_full_parity.py) remains the slow tier.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from deepinteract_tpu.data.graph import PairedComplex, ProteinGraph
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+from deepinteract_tpu.training.import_torch import convert_state_dict
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "golden",
+                       "full_model_parity.npz")
+
+
+def _load_fixture():
+    data = dict(np.load(FIXTURE))
+    sd = {k[len("sd/"):]: v for k, v in data.items() if k.startswith("sd/")}
+
+    def graph(leg):
+        fields = {f: data[f"cx/{leg}/{f}"] for f in (
+            "node_feats", "coords", "edge_feats", "nbr_idx",
+            "src_nbr_eids", "dst_nbr_eids", "node_mask", "num_nodes")}
+        return ProteinGraph(**fields)
+
+    cx = PairedComplex(
+        graph1=graph("graph1"), graph2=graph("graph2"),
+        examples=data["cx/examples"], example_mask=data["cx/example_mask"],
+        contact_map=data["cx/contact_map"],
+    )
+    meta = {k[len("meta/"):]: int(v) for k, v in data.items()
+            if k.startswith("meta/")}
+    return sd, cx, data["ref_logits"], meta
+
+
+def test_golden_full_model_logit_parity():
+    sd, cx, ref_logits, meta = _load_fixture()
+    cfg = ModelConfig(
+        gnn=GTConfig(num_layers=2, hidden=meta["hidden"],
+                     num_heads=meta["heads"], dropout_rate=0.0,
+                     node_count_limit=meta["limit"],
+                     attention_mode="scatter", attention_impl="jnp"),
+        decoder=DecoderConfig(num_chunks=meta["num_chunks"],
+                              num_channels=meta["hidden"]),
+    )
+    variables, report = convert_state_dict(sd, cfg, cx)
+    assert not report.unconsumed
+
+    ours = DeepInteract(cfg).apply(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        cx.graph1, cx.graph2, train=False,
+    )
+    ours_nchw = np.transpose(np.asarray(ours), (0, 3, 1, 2))
+    np.testing.assert_allclose(ours_nchw, ref_logits, rtol=1e-4, atol=1e-4)

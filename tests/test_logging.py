@@ -104,3 +104,86 @@ def test_cli_writer_composition(tmp_path):
     assert isinstance(w, FanoutWriter) and len(w.writers) == 2
     w.add_scalar("loss", 1.0, 0)
     w.close()
+
+
+def test_experiment_name_convention():
+    """Default run name follows the reference convention
+    (lit_model_train.py:93-98)."""
+    from deepinteract_tpu.cli.args import build_parser, default_experiment_name
+
+    args = build_parser("t").parse_args([])
+    assert default_experiment_name(args) == "LitGINI-b1-gl2-n128-e128-il14-i128"
+    args = build_parser("t").parse_args(["--experiment_name", "custom"])
+    assert default_experiment_name(args) == "custom"
+
+
+def test_checkpoint_artifact_upload(tmp_path):
+    calls = _install_fake_wandb()
+    mod = sys.modules["wandb"]
+
+    class _Artifact:
+        def __init__(self, name, type):
+            calls.setdefault("artifacts", []).append((name, type))
+            self.dirs = []
+
+        def add_dir(self, d):
+            self.dirs.append(d)
+
+    mod.Artifact = _Artifact
+
+    class _Run2:
+        id = "abc123"
+
+        def log_artifact(self, artifact, aliases=None):
+            calls.setdefault("logged_artifacts", []).append(
+                (artifact.dirs, tuple(aliases)))
+
+        def finish(self):
+            pass
+
+    mod.init = lambda **kw: _Run2()
+
+    from deepinteract_tpu.training.wandb_logger import WandbWriter
+
+    w = WandbWriter("proj")
+    w.log_checkpoint_artifact(str(tmp_path))
+    assert calls["artifacts"][-1] == ("model-abc123", "model")
+    assert calls["logged_artifacts"][-1] == ([str(tmp_path)], ("best", "latest"))
+
+
+def test_resolve_checkpoint_source(tmp_path):
+    """Local dir wins; missing dir + run_id downloads the artifact; neither
+    is a hard error (reference restore order, lit_model_test.py:121-130)."""
+    import argparse
+    import pytest
+
+    from deepinteract_tpu.cli.test import resolve_checkpoint_source
+
+    def ns(**kw):
+        base = dict(ckpt_name=None, ckpt_dir=None, wandb_run_id=None,
+                    wandb_project="proj", wandb_entity=None)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    local = tmp_path / "ckpt"
+    local.mkdir()
+    assert resolve_checkpoint_source(ns(ckpt_dir=str(local))) == str(local)
+
+    downloads = []
+
+    def fake_download(project, run_id, entity=None):
+        downloads.append((project, run_id, entity))
+        return str(tmp_path / "artifact")
+
+    got = resolve_checkpoint_source(
+        ns(ckpt_dir=str(tmp_path / "missing"), wandb_run_id="r1"),
+        download=fake_download)
+    assert got == str(tmp_path / "artifact")
+    assert downloads == [("proj", "r1", None)]
+
+    with pytest.raises(SystemExit):
+        resolve_checkpoint_source(
+            ns(ckpt_dir=str(tmp_path / "missing"), wandb_run_id="r2"),
+            download=lambda *a, **k: None)
+    with pytest.raises(SystemExit):
+        resolve_checkpoint_source(ns())

@@ -1,0 +1,129 @@
+"""Generate the checked-in golden full-model parity fixture.
+
+Runs the reference's own torch pipeline (DGLGeometricTransformer + input
+embedding + interaction tensor + ResNet2DInputWithOptAttention, via the
+mini-DGL shim in tests/reference_oracle.py) on a real featurized graph
+pair with live random weights, then saves to
+``tests/golden/full_model_parity.npz``:
+
+* ``sd/<key>``   — the reference state_dict (numpy, torch layout),
+* ``cx/<field>`` — the stacked PairedComplex our model consumes,
+* ``ref_logits`` — the reference's output contact logits [1, 2, N1, N2],
+* ``meta/*``     — the model hyperparameters needed to rebuild our config.
+
+This makes ``tests/test_golden_parity.py`` a torch-free, always-on
+full-model parity check (VERDICT r3 item 7); the live-oracle variant in
+``tests/test_reference_full_parity.py`` remains the slow tier. Regenerate
+only when the featurizer or importer schema changes:
+
+    python tools/make_golden_fixture.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+HIDDEN = 16
+HEADS = 2
+LIMIT = 32
+NUM_CHUNKS = 2
+N1, N2 = 26, 22
+KNN = 6
+GEO = 2
+
+
+def main() -> int:
+    import torch
+
+    from reference_oracle import fake_graph_from_raw, import_reference_modules
+
+    from deepinteract_tpu.data.features import featurize_chain
+    from deepinteract_tpu.data.graph import PairedComplex, pad_graph, stack_complexes
+    from deepinteract_tpu.data.synthetic import random_backbone, random_residue_feats
+
+    mods = import_reference_modules()
+    from project.utils.deepinteract_constants import FEATURE_INDICES
+
+    rng = np.random.default_rng(3)
+
+    def chain_raw(n, origin):
+        bb = random_backbone(n, rng, origin=origin)
+        return featurize_chain(bb, random_residue_feats(n, rng), knn=KNN,
+                               geo_nbrhd_size=GEO, rng=rng)
+
+    raw1 = chain_raw(N1, np.zeros(3))
+    raw2 = chain_raw(N2, np.array([10.0, 0.0, 0.0]))
+
+    torch.manual_seed(0)
+    embed = torch.nn.Linear(113, HIDDEN, bias=False)
+    gnn = mods.DGLGeometricTransformer(
+        node_count_limit=LIMIT, num_hidden_channels=HIDDEN,
+        num_attention_heads=HEADS, dropout_rate=0.0, num_layers=2,
+        feature_indices=FEATURE_INDICES,
+    )
+    dec = mods.ResNet2DInputWithOptAttention(
+        num_chunks=NUM_CHUNKS, init_channels=2 * HIDDEN, num_channels=HIDDEN,
+        num_classes=2, module_name="interaction",
+    )
+    g = torch.Generator().manual_seed(7)
+    for m in gnn.modules():
+        if isinstance(m, torch.nn.BatchNorm1d):
+            with torch.no_grad():
+                m.running_mean.normal_(0.0, 0.5, generator=g)
+                m.running_var.uniform_(0.5, 2.0, generator=g)
+    embed.eval(), gnn.eval(), dec.eval()
+
+    def ref_leg(raw):
+        gg = fake_graph_from_raw(raw)
+        gg.ndata["f"] = embed(gg.ndata["f"])
+        gg = gnn(gg)
+        return gg.ndata["f"]
+
+    with torch.no_grad():
+        f1, f2 = ref_leg(raw1), ref_leg(raw2)
+        t = torch.cat(
+            [f1.T[None, :, :, None].expand(1, HIDDEN, N1, N2),
+             f2.T[None, :, None, :].expand(1, HIDDEN, N1, N2)], dim=1)
+        ref_logits = dec(t).numpy()
+
+    sd = {f"node_in_embedding.{k}": v.numpy() for k, v in embed.state_dict().items()}
+    sd.update({f"gnn_module.0.{k}": v.numpy() for k, v in gnn.state_dict().items()})
+    sd.update({f"interact_module.{k}": v.numpy() for k, v in dec.state_dict().items()})
+
+    cx = stack_complexes([PairedComplex(
+        graph1=pad_graph(raw1, N1), graph2=pad_graph(raw2, N2),
+        examples=np.zeros((N1 * N2, 3), np.int32),
+        example_mask=np.ones(N1 * N2, bool),
+        contact_map=np.zeros((N1, N2), np.int32),
+    )])
+
+    payload = {f"sd/{k}": np.asarray(v) for k, v in sd.items()}
+    for leg in ("graph1", "graph2"):
+        gobj = getattr(cx, leg)
+        for field in ("node_feats", "coords", "edge_feats", "nbr_idx",
+                      "src_nbr_eids", "dst_nbr_eids", "node_mask", "num_nodes"):
+            payload[f"cx/{leg}/{field}"] = np.asarray(getattr(gobj, field))
+    for field in ("examples", "example_mask", "contact_map"):
+        payload[f"cx/{field}"] = np.asarray(getattr(cx, field))
+    payload["ref_logits"] = ref_logits
+    payload["meta/hidden"] = np.asarray(HIDDEN)
+    payload["meta/heads"] = np.asarray(HEADS)
+    payload["meta/limit"] = np.asarray(LIMIT)
+    payload["meta/num_chunks"] = np.asarray(NUM_CHUNKS)
+
+    out = os.path.join(REPO, "tests", "golden", "full_model_parity.npz")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    np.savez_compressed(out, **payload)
+    print(f"wrote {out} ({os.path.getsize(out) / 1e6:.2f} MB, "
+          f"{len(payload)} arrays)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
