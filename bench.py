@@ -529,7 +529,10 @@ def main() -> None:
             return DeepInteract(dataclasses.replace(base, **overrides))
 
         for label, mk in (
-            ("b1_p384_tiled",
+            ("b1_p384_tiled",  # 3x3 grid of 128-tiles
+             lambda: make_extra(tile_pair_map=True, tile_size=128,
+                                node_count_limit=4096)),
+            ("b1_p512_tiled",  # 2x the reference's 256-residue cap
              lambda: make_extra(tile_pair_map=True, node_count_limit=4096)),
             ("b1_p128_deeplab",
              lambda: make_extra(interact_module_type="deeplab")
@@ -541,8 +544,9 @@ def main() -> None:
                     detail["buckets"][label] = {
                         "skipped": "deeplab path is float32-only"}
                     continue
-                pad = 384 if "384" in label else 128
-                n1, n2 = (370, 350) if pad == 384 else (100, 80)
+                pad = 384 if "384" in label else 512 if "512" in label else 128
+                n1, n2 = {384: (370, 350), 512: (500, 470),
+                          128: (100, 80)}[pad]
                 batch = _make_batch(1, n1, n2, pad)
                 state = create_train_state(
                     m, batch, optim_cfg=OptimConfig(steps_per_epoch=100,

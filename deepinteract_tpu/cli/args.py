@@ -59,6 +59,12 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                    default=16,
                    help="DeepLabV3+ encoder output stride "
                         "(vision_modules.py:99-110,256)")
+    g.add_argument("--deeplab_encoder",
+                   choices=("resnet18", "resnet34", "resnet50"),
+                   default="resnet34",
+                   help="DeepLabV3+ encoder backbone (the reference's "
+                        "TimmUniversalEncoder routing, "
+                        "vision_modules.py:525-609)")
     g.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
                    default="float32",
                    help="decoder activation dtype; bfloat16 halves HBM "
@@ -72,6 +78,10 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                         "of nn.scan (the pre-r4 param layout; needed to "
                         "load checkpoints saved with the unrolled tree — "
                         "scan compiles ~5x faster, same numerics)")
+    g.add_argument("--no_depad_stats", action="store_true",
+                   help="disable the decoder's de-padded statistics fast "
+                        "path and use the plain masked reductions "
+                        "(numerics-equivalent; for A/B debugging)")
     g.add_argument("--dropout_rate", type=float, default=0.2)
     g.add_argument("--attention_mode", choices=("scatter", "gather"), default="scatter",
                    help="scatter = reference-exact edge softmax; gather = "
@@ -187,6 +197,7 @@ def configs_from_args(
         remat=args.remat,
         compute_dtype=args.compute_dtype,
         scan_chunks=not args.unrolled_decoder,
+        depad_stats=not args.no_depad_stats,
     )
     from deepinteract_tpu.models.vision import DeepLabConfig
 
@@ -199,7 +210,8 @@ def configs_from_args(
         gnn=gnn,
         decoder=decoder,
         deeplab=DeepLabConfig(dropout_rate=args.dropout_rate, remat=args.remat,
-                              output_stride=args.deeplab_output_stride),
+                              output_stride=args.deeplab_output_stride,
+                              encoder_name=args.deeplab_encoder),
         gnn_layer_type=args.gnn_layer_type,
         interact_module_type=args.interact_module_type,
         shard_pair_map=args.shard_pair_map or args.num_pair_shards > 1,

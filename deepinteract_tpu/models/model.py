@@ -169,6 +169,7 @@ class DeepInteract(nn.Module):
                 self.decoder, feats1, feats2,
                 graph1.node_mask, graph2.node_mask,
                 tile=self.cfg.tile_size, train=train,
+                shard_pair_axis=self.cfg.shard_pair_map,
             )
         else:
             pm = pair_mask(graph1.node_mask, graph2.node_mask)

@@ -46,6 +46,7 @@ def tiled_decode(
     mask2: jnp.ndarray,
     tile: int,
     train: bool = False,
+    shard_pair_axis: bool = False,
 ) -> jnp.ndarray:
     """Decode the [B, L1, L2] pair map in T x T tiles.
 
@@ -54,6 +55,11 @@ def tiled_decode(
         the untiled path).
       feats1, feats2: [B, L1, C], [B, L2, C] encoded node features.
       mask1, mask2:   [B, L1], [B, L2] validity masks.
+      shard_pair_axis: context parallelism *within* each tile — annotate
+        the tile's row axis for the mesh's 'pair' axis (requires an active
+        mesh, like ModelConfig.shard_pair_map's untiled path). The tile
+        grid stays a sequential scan; each tile's convs shard across
+        devices with XLA inserting the halo exchanges.
 
     Returns [B, L1, L2, num_classes] logits (padded region zeroed).
     """
@@ -75,6 +81,14 @@ def tiled_decode(
             axis=-1,
         )
         pm = m1[:, :, None] & m2[:, None, :]
+        if shard_pair_axis:
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            from deepinteract_tpu.parallel.mesh import PAIR_AXIS
+
+            pair = jax.lax.with_sharding_constraint(pair, P(None, PAIR_AXIS))
+            pm = jax.lax.with_sharding_constraint(pm, P(None, PAIR_AXIS))
         logits = dec(pair, pm, train=train)
         return carry, logits
 

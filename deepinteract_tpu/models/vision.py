@@ -49,9 +49,18 @@ class DeepLabConfig:
 
     in_channels: int = 256  # 2 * GNN hidden
     num_classes: int = 2
+    # Encoder backbone. The reference's DeepLabV3Plus routes either
+    # torchvision resnet34 or ANY timm model via TimmUniversalEncoder
+    # (vision_modules.py:525-609); the TPU-native equivalent is a
+    # from-scratch encoder zoo: 'resnet18'/'resnet34' (basic blocks) and
+    # 'resnet50' (bottleneck blocks). stage_channels/stage_blocks derive
+    # from the name when left at the resnet34 defaults.
+    encoder_name: str = "resnet34"
     stem_channels: int = 64
-    stage_channels: Sequence[int] = (64, 128, 256, 512)
-    stage_blocks: Sequence[int] = (3, 4, 6, 3)  # resnet34
+    # None = derive from encoder_name (ENCODER_ZOO); explicit values always
+    # win, whatever they are.
+    stage_channels: Optional[Sequence[int]] = None
+    stage_blocks: Optional[Sequence[int]] = None
     aspp_rates: Sequence[int] = (12, 24, 36)
     decoder_channels: int = 256
     high_res_channels: int = 48  # 1x1-projected skip width (DeepLab standard)
@@ -70,6 +79,18 @@ class DeepLabConfig:
     def __post_init__(self):
         if self.output_stride not in (8, 16):
             raise ValueError("DeepLabConfig.output_stride must be 8 or 16")
+        if self.encoder_name not in ENCODER_ZOO:
+            raise ValueError(
+                f"unknown encoder {self.encoder_name!r}; "
+                f"choose from {sorted(ENCODER_ZOO)}"
+            )
+        # Derive stage shapes from the encoder name only where the caller
+        # left them None — explicitly passed values always win.
+        _, zoo_blocks, zoo_channels = ENCODER_ZOO[self.encoder_name]
+        if self.stage_blocks is None:
+            object.__setattr__(self, "stage_blocks", zoo_blocks)
+        if self.stage_channels is None:
+            object.__setattr__(self, "stage_channels", zoo_channels)
 
 
 def _pool_mask(mask: jnp.ndarray, factor: int) -> jnp.ndarray:
@@ -149,10 +170,50 @@ class BasicBlock(nn.Module):
         return nn.relu(y + identity)
 
 
+class BottleneckResBlock(nn.Module):
+    """ResNet-50-style bottleneck: 1x1 reduce -> 3x3 -> 1x1 expand with
+    identity/projection shortcut (the torchvision Bottleneck the
+    reference's universal encoder pulls in for deeper backbones)."""
+
+    features: int  # expanded output width
+    stride: int = 1
+    dilation: int = 1
+    use_projection: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        identity = x
+        mid = self.features // 4
+        # Stride on the first 1x1 (ResNet v1 convention): the downsampled
+        # mask the encoder passes then matches every norm in the block
+        # (stride on the 3x3, v1.5, would hand the first norm a mask at
+        # the wrong scale).
+        y = ConvNormAct(mid, 1, self.stride)(x, mask)
+        y = ConvNormAct(mid, 3, 1, self.dilation)(y, mask)
+        y = ConvNormAct(self.features, 1, use_act=False)(y, mask)
+        project = (
+            self.use_projection if self.use_projection is not None
+            else self.stride != 1 or x.shape[-1] != self.features
+        )
+        if project:
+            identity = ConvNormAct(self.features, 1, self.stride, use_act=False)(x, mask)
+        return nn.relu(y + identity)
+
+
+# encoder_name -> (block class name, stage_blocks, stage_channels). Class
+# resolved lazily (classes are defined above/below this table).
+ENCODER_ZOO = {
+    "resnet18": ("basic", (2, 2, 2, 2), (64, 128, 256, 512)),
+    "resnet34": ("basic", (3, 4, 6, 3), (64, 128, 256, 512)),
+    "resnet50": ("bottleneck", (3, 4, 6, 3), (256, 512, 1024, 2048)),
+}
+
+
 class ResNetEncoder(nn.Module):
-    """Stem + 4 basic-block stages; returns (1/4-scale skip, 1/16-scale
+    """Stem + 4 residual stages; returns (1/4-scale skip, 1/16-scale
     deep features) — the two taps DeepLabV3+ consumes
-    (vision_modules.py:201-219)."""
+    (vision_modules.py:201-219). The block family comes from
+    ``cfg.encoder_name`` (see ENCODER_ZOO)."""
 
     cfg: DeepLabConfig
 
@@ -174,7 +235,11 @@ class ResNetEncoder(nn.Module):
         skip = None
         m = m4
         scale = 4
-        block_cls = nn.remat(BasicBlock) if cfg.remat else BasicBlock
+        base_block = (
+            BottleneckResBlock
+            if ENCODER_ZOO[cfg.encoder_name][0] == "bottleneck" else BasicBlock
+        )
+        block_cls = nn.remat(base_block) if cfg.remat else base_block
         # Stage (stride, dilation) patterns (make_dilated,
         # vision_modules.py:99-110): os-16 dilates the final stage, os-8
         # runs the last two stages at stride 1 with dilations 2 and 4.

@@ -1,0 +1,119 @@
+"""Conv-path characterization for the decoder (device-loop timing).
+
+tools/decoder_ablation.py shows the bare conv skeleton runs ~5.8 ms at
+p128 (~19 TFLOP/s on a 197 TFLOP/s chip). This probe isolates why:
+
+  dilated-f32    — the real cycle (dilations 1,2,4,8), f32
+  d1-f32         — same convs, all dilation 1 (is dilated lowering slow?)
+  dilated-bf16   — bf16 activations AND conv compute
+  wide-f32       — 3x3 at full 128 channels, no bottleneck (MXU packing)
+  conv3x3-x56    — 56 plain 3x3 convs at 64ch (per-op floor)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+K = 32
+
+
+def device_loop_time(apply_fn, variables, x):
+    import jax
+    import jax.numpy as jnp
+
+    def looped(v, x):
+        def body(acc, i):
+            out = apply_fn(v, x + (i * 1e-6 + acc * 1e-20))
+            return acc + jnp.sum(out).astype(jnp.float32) * 1e-6, None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(K, dtype=jnp.float32))
+        return acc
+
+    jloop = jax.jit(looped)
+    cl = jloop.lower(variables, x).compile()
+    out = cl(variables, x)
+    float(jax.device_get(out))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = cl(variables, x)
+        float(jax.device_get(out))
+        samples.append((time.perf_counter() - t0) / K)
+    return float(np.median(samples))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    pad = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    print(f"device={jax.devices()[0].device_kind} pad={pad} K={K}", flush=True)
+    rng = np.random.default_rng(0)
+
+    def make_stack(cycle, dtype, mid, kernel=3):
+        class Chunk(nn.Module):
+            @nn.compact
+            def __call__(self, hh):
+                for d in cycle:
+                    r = hh
+                    hh = nn.Conv(mid, (1, 1), dtype=dtype)(nn.elu(hh))
+                    hh = nn.Conv(mid, (kernel, kernel), kernel_dilation=(d, d),
+                                 padding=d if kernel == 3 else 0,
+                                 dtype=dtype)(nn.elu(hh))
+                    hh = nn.Conv(128, (1, 1), dtype=dtype)(nn.elu(hh))
+                    hh = hh + r
+                return hh, None
+
+        class Stack(nn.Module):
+            @nn.compact
+            def __call__(self, t):
+                scan = nn.scan(Chunk, variable_axes={"params": 0},
+                               split_rngs={"params": True}, length=14)
+                h, _ = scan(name="chunks")(t.astype(dtype))
+                return h.astype(jnp.float32)
+
+        return Stack()
+
+    x = jnp.asarray(rng.standard_normal((1, pad, pad, 128)).astype(np.float32))
+
+    for name, module in (
+        ("dilated-f32", make_stack((1, 2, 4, 8), jnp.float32, 64)),
+        ("d1-f32", make_stack((1, 1, 1, 1), jnp.float32, 64)),
+        ("dilated-bf16", make_stack((1, 2, 4, 8), jnp.bfloat16, 64)),
+        ("wide-f32", make_stack((1, 2, 4, 8), jnp.float32, 128)),
+    ):
+        variables = module.init(jax.random.PRNGKey(0), x)
+        t = device_loop_time(lambda v, xx: module.apply(v, xx), variables, x)
+        print(f"{name:14s} {t*1e3:8.3f} ms/iter", flush=True)
+
+    class Plain3x3(nn.Module):
+        @nn.compact
+        def __call__(self, t):
+            h = t[..., :64]
+
+            class One(nn.Module):
+                @nn.compact
+                def __call__(self, hh):
+                    return nn.Conv(64, (3, 3), padding=1)(hh), None
+
+            scan = nn.scan(One, variable_axes={"params": 0},
+                           split_rngs={"params": True}, length=56)
+            h, _ = scan(name="convs")(h)
+            return h
+
+    module = Plain3x3()
+    variables = module.init(jax.random.PRNGKey(0), x)
+    t = device_loop_time(lambda v, xx: module.apply(v, xx), variables, x)
+    gflop = 56 * 2 * 9 * 64 * 64 * pad * pad / 1e9
+    print(f"conv3x3-x56    {t*1e3:8.3f} ms/iter  "
+          f"({gflop / t / 1e3:.1f} TFLOP/s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

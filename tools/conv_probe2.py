@@ -1,0 +1,102 @@
+"""Second conv probe: conv lowering vs equivalent matmul, and precision.
+
+conv3x3 at [1,128,128,64] measured 11 TFLOP/s (tools/conv_probe.py). Is
+that the conv LOWERING or the MXU configuration? Compare:
+
+  conv3x3 prec=DEFAULT / HIGHEST   — explicit precision
+  matmul-eq                        — [16384,576]x[576,64] einsum, the same
+                                     contraction as the conv's im2col
+  matmul-sq                        — [4096,512]x[512,512] square control
+  conv3x3-b8                       — batch 8 (amortize per-op overhead)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+K = 32
+
+
+def loop_time(fn, *args):
+    import jax
+    import jax.numpy as jnp
+
+    def looped(*a):
+        def body(acc, i):
+            out = fn(*a, acc, i)
+            return acc + jnp.sum(out).astype(jnp.float32) * 1e-30, None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(K, dtype=jnp.float32))
+        return acc
+
+    cl = jax.jit(looped).lower(*args).compile()
+    out = cl(*args)
+    float(jax.device_get(out))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = cl(*args)
+        float(jax.device_get(out))
+        samples.append((time.perf_counter() - t0) / K)
+    return float(np.median(samples))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    print(f"device={jax.devices()[0].device_kind} K={K}", flush=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((1, 128, 128, 64)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 3, 64, 64)).astype(np.float32) * 0.1)
+
+    for prec in ("default", "highest"):
+        def conv(xx, ww, acc, i, _p=prec):
+            return lax.conv_general_dilated(
+                xx + acc * 1e-30 + i * 1e-9, ww, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=_p)
+
+        t = loop_time(conv, x, w)
+        gf = 2 * 9 * 64 * 64 * 128 * 128 / 1e9
+        print(f"conv3x3 prec={prec:8s} {t*1e6:9.1f} us  ({gf/t/1e3:.1f} TFLOP/s)",
+              flush=True)
+
+    a = jnp.asarray(rng.standard_normal((16384, 576)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((576, 64)).astype(np.float32))
+
+    def mm(aa, bb, acc, i):
+        return (aa + acc * 1e-30 + i * 1e-9) @ bb
+
+    t = loop_time(mm, a, b)
+    gf = 2 * 16384 * 576 * 64 / 1e9
+    print(f"matmul-eq [16384,576]x[576,64] {t*1e6:9.1f} us  "
+          f"({gf/t/1e3:.1f} TFLOP/s)", flush=True)
+
+    a2 = jnp.asarray(rng.standard_normal((4096, 512)).astype(np.float32))
+    b2 = jnp.asarray(rng.standard_normal((512, 512)).astype(np.float32))
+    t = loop_time(mm, a2, b2)
+    gf = 2 * 4096 * 512 * 512 / 1e9
+    print(f"matmul-sq [4096,512]x[512,512] {t*1e6:9.1f} us  "
+          f"({gf/t/1e3:.1f} TFLOP/s)", flush=True)
+
+    x8 = jnp.asarray(rng.standard_normal((8, 128, 128, 64)).astype(np.float32))
+
+    def conv8(xx, ww, acc, i):
+        return lax.conv_general_dilated(
+            xx + acc * 1e-30 + i * 1e-9, ww, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    t = loop_time(conv8, x8, w)
+    gf = 8 * 2 * 9 * 64 * 64 * 128 * 128 / 1e9
+    print(f"conv3x3-b8            {t*1e6:9.1f} us  ({gf/t/1e3:.1f} TFLOP/s)",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

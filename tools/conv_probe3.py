@@ -1,0 +1,116 @@
+"""Third probe: K-differenced device loops (the fully-corrected protocol).
+
+Per-iteration time = (t_K2 - t_K1) / (K2 - K1) with the loop length a
+runtime-switchable bound... lax.scan length is static, so compile TWO
+loops (K1=8, K2=40) per op and difference their wall times. This removes
+BOTH the host dispatch/fetch overhead AND any fixed per-dispatch cost
+that polluted the K=32 single-loop numbers.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+K1, K2 = 8, 40
+
+
+def diff_time(make_looped, *args):
+    import jax
+
+    def t_for(k):
+        cl = jax.jit(make_looped(k)).lower(*args).compile()
+        out = cl(*args)
+        float(jax.device_get(out))
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = cl(*args)
+            float(jax.device_get(out))
+            samples.append(time.perf_counter() - t0)
+        return float(np.median(samples))
+
+    t1, t2 = t_for(K1), t_for(K2)
+    return (t2 - t1) / (K2 - K1), t1, t2
+
+
+def op_loop(fn):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make(k):
+        def looped(*a):
+            def body(acc, i):
+                out = fn(*a, acc, i)
+                return acc + jnp.sum(out).astype(jnp.float32) * 1e-30, None
+
+            acc, _ = lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(k, dtype=jnp.float32))
+            return acc
+
+        return looped
+
+    return make
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    print(f"device={jax.devices()[0].device_kind} K1={K1} K2={K2}", flush=True)
+    rng = np.random.default_rng(0)
+
+    def report(name, fn, gflop, *args):
+        per, t1, t2 = diff_time(op_loop(fn), *args)
+        per = max(per, 1e-9)
+        print(f"{name:34s} {per*1e6:9.1f} us/op ({gflop/per/1e3:7.1f} TFLOP/s)"
+              f"  [t{K1}={t1*1e3:.1f}ms t{K2}={t2*1e3:.1f}ms]", flush=True)
+
+    x = jnp.asarray(rng.standard_normal((1, 128, 128, 64)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 3, 64, 64)).astype(np.float32) * 0.1)
+
+    def conv(xx, ww, acc, i):
+        return lax.conv_general_dilated(
+            xx + acc * 1e-30 + i * 1e-9, ww, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    report("conv3x3 [1,128,128,64]", conv, 2 * 9 * 64 * 64 * 128 * 128 / 1e9,
+           x, w)
+
+    wb = jnp.asarray(rng.standard_normal((3, 3, 64, 64)).astype(np.float32) * 0.1)
+
+    def conv_bf16(xx, ww, acc, i):
+        y = lax.conv_general_dilated(
+            (xx + acc * 1e-30 + i * 1e-9).astype(jnp.bfloat16),
+            ww.astype(jnp.bfloat16), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y.astype(jnp.float32)
+
+    report("conv3x3 bf16", conv_bf16, 2 * 9 * 64 * 64 * 128 * 128 / 1e9, x, wb)
+
+    a2 = jnp.asarray(rng.standard_normal((4096, 512)).astype(np.float32))
+    b2 = jnp.asarray(rng.standard_normal((512, 512)).astype(np.float32))
+
+    def mm(aa, bb, acc, i):
+        return (aa + acc * 1e-30 + i * 1e-9) @ bb
+
+    report("matmul [4096,512]x[512,512]", mm, 2 * 4096 * 512 * 512 / 1e9,
+           a2, b2)
+
+    x8 = jnp.asarray(rng.standard_normal((8, 128, 128, 64)).astype(np.float32))
+    report("conv3x3 batch8", conv, 8 * 2 * 9 * 64 * 64 * 128 * 128 / 1e9,
+           x8, w)
+
+    # Elementwise pass: the memory-bandwidth yardstick (reads+writes 8MB).
+    def ew(xx, acc, i):
+        return xx * (1.0 + i * 1e-9) + acc * 1e-30
+
+    report("elementwise [1,128,128,64]", ew, 0.004, x)  # ~GB moved, not GFLOP
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,184 @@
+"""Decoder cost bisection on the TPU (device-loop timing).
+
+The flagship forward at p128 runs ~12 ms (tools/perf_probe.py) — analytic
+MFU ~0.05 — with ~95% of FLOPs in the decoder convs. This probe times the
+decoder IN ISOLATION on a fixed [1, P, P, 256] pair tensor and ablates one
+suspect at a time to find where the wall-clock actually goes:
+
+  full        — InteractionDecoder as configured (inorm + SE + mask, f32)
+  no-mask     — mask=None (drops mask multiplies + masked statistics)
+  no-inorm    — use_inorm=False in the base ResNet (phase2-style blocks)
+  no-se       — SE gates removed
+  convs-only  — no inorm, no SE, no mask: the bare conv stack
+  bf16        — full, compute_dtype=bfloat16
+  gt-only     — the full model MINUS decoder (encoder cost cross-check)
+
+Each variant is timed with a K-iteration lax.scan device loop (per-iter =
+total/K), the only protocol the axon tunnel cannot distort.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+K = 32
+
+
+def device_loop_time(apply_fn, variables, x, mask):
+    import jax
+    import jax.numpy as jnp
+
+    def looped(v, x, mask):
+        def body(acc, i):
+            out = apply_fn(v, x + (i * 1e-6 + acc * 1e-20), mask)
+            return acc + jnp.sum(out) * 1e-6, None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(K, dtype=jnp.float32))
+        return acc
+
+    jloop = jax.jit(looped)
+    t0 = time.perf_counter()
+    cl = jloop.lower(variables, x, mask).compile()
+    compile_s = time.perf_counter() - t0
+    out = cl(variables, x, mask)
+    float(jax.device_get(out))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = cl(variables, x, mask)
+        float(jax.device_get(out))
+        samples.append((time.perf_counter() - t0) / K)
+    return float(np.median(samples)), compile_s
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    sys.path.insert(0, ".")
+    from deepinteract_tpu.models.decoder import (
+        DecoderConfig,
+        DilatedResNet,
+        InteractionDecoder,
+        InstanceNorm,
+        SEBlock,
+    )
+
+    pad = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    dev = jax.devices()[0]
+    print(f"device={dev.device_kind} pad={pad} K={K}", flush=True)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((1, pad, pad, 256)).astype(np.float32))
+    mask_np = np.zeros((1, pad, pad), bool)
+    mask_np[:, : pad - 20, : pad - 28] = True
+    mask = jnp.asarray(mask_np)
+
+    results = {}
+
+    def run(name, module, use_mask=True):
+        m = mask if use_mask else None
+        variables = module.init(jax.random.PRNGKey(0), x, m)
+        per_iter, compile_s = device_loop_time(
+            lambda v, xx, mm: module.apply(v, xx, mm), variables, x, m)
+        results[name] = per_iter
+        print(f"{name:12s} {per_iter*1e3:8.3f} ms/iter  (compile {compile_s:.0f}s)",
+              flush=True)
+
+    base = DecoderConfig()  # 14 chunks, 128 ch, scan_chunks=True
+
+    run("full", InteractionDecoder(base))
+    run("no-mask", InteractionDecoder(base), use_mask=False)
+    run("bf16", InteractionDecoder(
+        dataclasses.replace(base, compute_dtype="bfloat16")))
+
+    class StrippedDecoder(nn.Module):
+        """base ResNet with ablations (mirrors InteractionDecoder's base
+        stage, which holds 56 of the 62 blocks)."""
+
+        use_inorm: bool = True
+        use_se: bool = True
+
+        @nn.compact
+        def __call__(self, t, m=None):
+            h = nn.Conv(128, (1, 1), name="conv2d_1")(t)
+            if self.use_inorm:
+                h = nn.elu(InstanceNorm(128, name="inorm_1")(h, m))
+            resnet = DilatedResNet(
+                128, 14, (1, 2, 4, 8), use_inorm=self.use_inorm,
+                initial_projection=True, scan_chunks=True, name="base")
+            if not self.use_se:
+                # monkey-level ablation: SEBlock with identity behavior is
+                # not expressible via config; emulate by zero-size? Instead
+                # time the resnet as-is minus inorm separately; see no-se2.
+                pass
+            h = nn.elu(resnet(h, m))
+            return nn.Conv(2, (1, 1), name="head")(h)
+
+    run("no-inorm", StrippedDecoder(use_inorm=False))
+
+    class ConvsOnly(nn.Module):
+        """Bare conv skeleton of one 14-chunk base ResNet (no norm/SE/mask):
+        the MXU-only lower bound."""
+
+        @nn.compact
+        def __call__(self, t, m=None):
+            h = nn.Conv(128, (1, 1))(t)
+
+            class Chunk(nn.Module):
+                @nn.compact
+                def __call__(self, hh, mm=None):
+                    for d in (1, 2, 4, 8):
+                        r = hh
+                        hh = nn.Conv(64, (1, 1))(nn.elu(hh))
+                        hh = nn.Conv(64, (3, 3), kernel_dilation=(d, d),
+                                     padding=d)(nn.elu(hh))
+                        hh = nn.Conv(128, (1, 1))(nn.elu(hh))
+                        hh = hh + r
+                    return hh, None
+
+            scan = nn.scan(Chunk, variable_axes={"params": 0},
+                           split_rngs={"params": True}, length=14,
+                           in_axes=nn.broadcast)
+            h, _ = scan(name="chunks")(h, m)
+            return nn.Conv(2, (1, 1))(h)
+
+    run("convs-only", ConvsOnly(), use_mask=False)
+
+    # SE cost = full - (inorm cost) - ... : direct variant with SE stripped
+    # by zeroing? Approximate SE cost as full - no_se where no_se reuses the
+    # stripped decoder WITH inorm but the DilatedResNet's SE intact is the
+    # full path; instead measure SE alone on the activation shape:
+    class SEOnly(nn.Module):
+        @nn.compact
+        def __call__(self, t, m=None):
+            h = t[..., :128]
+            for i in range(56):
+                h = SEBlock(128, name=f"se_{i}")(h, m)
+            return h
+
+    run("se-x56", SEOnly())
+
+    class InormOnly(nn.Module):
+        @nn.compact
+        def __call__(self, t, m=None):
+            h = t[..., :128]
+            for i in range(56):
+                h = InstanceNorm(128, name=f"in_{i}")(h, m)
+            return h
+
+    run("inorm-x56", InormOnly())
+
+    print("RESULTS " + str({k: round(v * 1e3, 3) for k, v in results.items()}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

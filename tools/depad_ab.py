@@ -1,0 +1,79 @@
+"""K-differenced A/B of DecoderConfig.depad_stats on the full decoder."""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+K1, K2 = 8, 40
+
+
+def diff_time(apply_fn, variables, x, mask):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make(k):
+        def looped(v, xx, mm):
+            def body(acc, i):
+                out = apply_fn(v, xx + (i * 1e-6 + acc * 1e-20), mm)
+                return acc + jnp.sum(out).astype(jnp.float32) * 1e-6, None
+
+            acc, _ = lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(k, dtype=jnp.float32))
+            return acc
+
+        return looped
+
+    def t_for(k):
+        cl = jax.jit(make(k)).lower(variables, x, mask).compile()
+        out = cl(variables, x, mask)
+        float(jax.device_get(out))
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = cl(variables, x, mask)
+            float(jax.device_get(out))
+            samples.append(time.perf_counter() - t0)
+        return float(np.median(samples))
+
+    t1, t2 = t_for(K1), t_for(K2)
+    return (t2 - t1) / (K2 - K1)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
+
+    pad = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    print(f"device={jax.devices()[0].device_kind} pad={pad}", flush=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((1, pad, pad, 256)).astype(np.float32))
+    mask_np = np.zeros((1, pad, pad), bool)
+    mask_np[:, : pad - 20, : pad - 28] = True
+    mask = jnp.asarray(mask_np)
+
+    for label, kw in (
+        ("depad-f32", dict(depad_stats=True)),
+        ("masked-f32", dict(depad_stats=False)),
+        ("depad-bf16", dict(depad_stats=True, compute_dtype="bfloat16")),
+        ("masked-bf16", dict(depad_stats=False, compute_dtype="bfloat16")),
+        ("nomask-f32", dict(depad_stats=False)),
+    ):
+        cfg = DecoderConfig(**kw)
+        module = InteractionDecoder(cfg)
+        m = None if label.startswith("nomask") else mask
+        variables = module.init(jax.random.PRNGKey(0), x, m)
+        t = diff_time(lambda v, xx, mm: module.apply(v, xx, mm), variables, x, m)
+        print(f"{label:12s} {t*1e3:8.3f} ms/iter", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,135 @@
+"""TPU timing cross-validation probe.
+
+Three independent ways to time the flagship forward/train step, to
+establish which protocols are trustworthy through the axon tunnel:
+
+1. ``differenced``  — bench.py's protocol: (t_2k - t_k) / k with a host
+   fetch per run. Reported at several k to expose nonlinearity.
+2. ``device-loop``  — a lax.scan of K data-dependent iterations inside ONE
+   executable: per-iter = total/K. Immune to dispatch/fetch overhead by
+   construction (the loop lives on the device), at the cost of measuring
+   the scanned variant of the computation.
+3. ``fetch-cost``   — the host fetch alone, to size the fixed overhead.
+
+Also A/Bs the scanned-chunk decoder vs the unrolled decoder to separate
+"timing was wrong" from "the scan rewrite changed runtime".
+
+Usage: python tools/perf_probe.py [pad] (default 128)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from bench import _make_batch, _time_compiled
+
+    from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+
+    pad = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    n1, n2 = (100, 80) if pad == 128 else (230, 200)
+    dev = jax.devices()[0]
+    print(f"device={dev.device_kind} pad={pad}", flush=True)
+
+    batch = _make_batch(1, n1, n2, pad)
+
+    def make(scan_chunks):
+        base = ModelConfig()
+        return DeepInteract(dataclasses.replace(
+            base, decoder=dataclasses.replace(base.decoder,
+                                              scan_chunks=scan_chunks)))
+
+    results = {}
+    for name, scan_chunks in (("scanned", True), ("unrolled", False)):
+        model = make(scan_chunks)
+        variables = model.init(jax.random.PRNGKey(0), batch.graph1,
+                               batch.graph2, train=False)
+        params, bstats = variables["params"], variables.get("batch_stats", {})
+
+        fwd = jax.jit(lambda p, bs, b: model.apply(
+            {"params": p, "batch_stats": bs}, b.graph1, b.graph2, train=False))
+
+        # Protocol 1: differenced at k = 2, 4, 8.
+        t0 = time.perf_counter()
+        compiled = fwd.lower(params, bstats, batch).compile()
+        compile_s = time.perf_counter() - t0
+        print(f"[{name}] forward compile {compile_s:.1f}s", flush=True)
+        for k in (2, 4, 8):
+            _, timing, _ = _time_compiled(fwd, (params, bstats, batch),
+                                          iters=k * 3, reps=3)
+            print(f"[{name}] differenced k={timing['calls_per_sample']}: "
+                  f"median {timing['median']*1e3:.3f} ms  "
+                  f"min {timing['min']*1e3:.3f}  "
+                  f"overhead {timing['overhead_ms']:.1f} ms  "
+                  f"linearity {timing['linearity']:.3f}", flush=True)
+            results[f"{name}_diff_k{k}"] = timing["median"]
+
+        # Protocol 2: device-side loop, K iterations chained through a
+        # carried accumulator and a per-iteration input perturbation.
+        K = 32
+
+        def looped(p, bs, b):
+            def body(acc, i):
+                g1 = dataclasses.replace(
+                    b.graph1,
+                    node_feats=b.graph1.node_feats + (i * 1e-6 + acc * 1e-20))
+                out = model.apply({"params": p, "batch_stats": bs},
+                                  g1, b.graph2, train=False)
+                return acc + jnp.sum(out) * 1e-6, None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                  jnp.arange(K, dtype=jnp.float32))
+            return acc
+
+        jloop = jax.jit(looped)
+        t0 = time.perf_counter()
+        cl = jloop.lower(params, bstats, batch).compile()
+        print(f"[{name}] device-loop compile {time.perf_counter()-t0:.1f}s",
+              flush=True)
+        out = cl(params, bstats, batch)
+        float(jax.device_get(out))  # warm
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = cl(params, bstats, batch)
+            float(jax.device_get(out))
+            samples.append((time.perf_counter() - t0) / K)
+        per_iter = float(np.median(samples))
+        print(f"[{name}] device-loop K={K}: {per_iter*1e3:.3f} ms/iter",
+              flush=True)
+        results[f"{name}_loop"] = per_iter
+
+    # Protocol 3: fetch cost alone (small scalar vs the full logits).
+    model = make(True)
+    variables = model.init(jax.random.PRNGKey(0), batch.graph1, batch.graph2,
+                           train=False)
+    fwd = jax.jit(lambda p, bs, b: model.apply(
+        {"params": p, "batch_stats": bs}, b.graph1, b.graph2, train=False))
+    out = fwd(variables["params"], variables.get("batch_stats", {}), batch)
+    jax.block_until_ready(out)
+    for label, fetch in (
+        ("device_get(logits)", lambda: np.asarray(jax.device_get(out))),
+        ("block_until_ready", lambda: jax.block_until_ready(out)),
+    ):
+        t0 = time.perf_counter()
+        for _ in range(5):
+            fetch()
+        print(f"fetch {label}: {(time.perf_counter()-t0)/5*1e3:.1f} ms",
+              flush=True)
+
+    print("RESULTS " + str({k: round(v * 1e3, 3) for k, v in results.items()}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
