@@ -154,6 +154,28 @@ def analytic_train_flops(fwd: dict, remat: bool) -> float:
 # ---------------------------------------------------------------------------
 
 
+def _is_transient(exc: Exception) -> bool:
+    """Failure signatures of the axon PJRT tunnel worth retrying (shared by
+    every retry loop so a new signature only needs classifying once)."""
+    msg = str(exc)
+    return "remote_compile" in msg or "INTERNAL" in msg
+
+
+def _compile_with_retry(fn, args, attempts: int = 3):
+    """lower+compile with retries: the axon PJRT tunnel's remote_compile
+    sporadically drops the response mid-read (observed ~once per multi-
+    bucket run), which would otherwise cost the driver a whole bucket."""
+    for attempt in range(attempts):
+        try:
+            return fn.lower(*args).compile()
+        except Exception as exc:
+            if attempt == attempts - 1 or not _is_transient(exc):
+                raise
+            _log(f"transient compile failure (attempt {attempt + 1}): "
+                 f"{str(exc).splitlines()[0][:200]}; retrying")
+            time.sleep(5.0 * (attempt + 1))
+
+
 def _materialize(out) -> float:
     """Force HOST materialization of a value derived from ``out``.
 
@@ -208,7 +230,7 @@ def _time_compiled(fn, args, iters=ITERS, reps=REPS):
     import jax
 
     t0 = time.perf_counter()
-    compiled = fn.lower(*args).compile()
+    compiled = _compile_with_retry(fn, args)
     compile_s = time.perf_counter() - t0
     flops = None
     try:
@@ -366,14 +388,32 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k):
     return entry
 
 
-def main() -> None:
+# Shape table: label -> (batch, n1, n2, pad, remat). b1_p128 is the
+# headline; b1_p256 is the reference training regime (RESIDUE_COUNT_LIMIT
+# = 256, deepinteract_constants.py:10-12); b8+remat is the large-batch
+# config.
+BUCKET_SHAPES = {
+    "b1_p128": (1, 100, 80, 128, False),
+    # p256 runs with decoder remat: the scanned decoder's backward stores
+    # per-iteration scan residuals, which at 256x256 maps exceed a 16G
+    # v5e's HBM without rematerialization (measured: OOM at AllocateBuffer
+    # without, 208 ms/step with, r4). Real p256 training needs --remat too.
+    "b1_p256": (1, 230, 200, 256, True),
+    "b8_p128_remat": (8, 100, 80, 128, True),
+}
+EXTRA_SHAPES = {  # DI_BENCH_EXTRA=1 only
+    "b1_p384_tiled": (1, 370, 350, 384, False),
+    "b1_p512_tiled": (1, 500, 470, 512, False),
+    "b1_p128_deeplab": (1, 100, 80, 128, False),
+}
+
+
+def _setup():
     import dataclasses
 
     import jax
 
     from deepinteract_tpu.models.model import DeepInteract, ModelConfig
-    from deepinteract_tpu.training.optim import OptimConfig
-    from deepinteract_tpu.training.steps import create_train_state
 
     dev = jax.devices()[0]
     global PEAK_FLOPS
@@ -398,26 +438,68 @@ def main() -> None:
                 base.decoder, compute_dtype=bench_dtype, remat=remat),
         ))
 
-    model = make_model()
-    model_remat = make_model(remat=True)
-    detail = {"backend": dev.platform, "device_kind": dev.device_kind,
-              "iters": ITERS, "reps": REPS, "compute_dtype": bench_dtype,
-              "buckets": {}}
-    scan_k = int(os.environ.get("DI_BENCH_SCAN", "8"))
+    def make_extra(**overrides):
+        base = ModelConfig(
+            gnn=dataclasses.replace(
+                ModelConfig().gnn,
+                node_count_limit=overrides.pop("node_count_limit", 2304)),
+            decoder=dataclasses.replace(
+                ModelConfig().decoder, compute_dtype=bench_dtype),
+        )
+        return DeepInteract(dataclasses.replace(base, **overrides))
 
-    # (label, model, batch, n1, n2, pad, remat). b1_p128 is the headline;
-    # b1_p256 is the reference training regime (RESIDUE_COUNT_LIMIT=256,
-    # deepinteract_constants.py:10-12); b8+remat is the large-batch config.
-    shapes = [
-        ("b1_p128", model, 1, 100, 80, 128, False),
-        ("b1_p256", model, 1, 230, 200, 256, False),
-        ("b8_p128_remat", model_remat, 8, 100, 80, 128, True),
-    ]
+    return {
+        "dev": dev,
+        "bench_dtype": bench_dtype,
+        "make_model": make_model,
+        "make_extra": make_extra,
+        "scan_k": int(os.environ.get("DI_BENCH_SCAN", "8")),
+    }
+
+
+def _section_names(platform: str) -> list:
     if os.environ.get("DI_BENCH_FAST"):
-        shapes = shapes[:1]
-    headline = None
+        return ["b1_p128"]
+    names = list(BUCKET_SHAPES)
+    if platform == "tpu":
+        names.append("ab_p128")
+    if os.environ.get("DI_BENCH_EXTRA"):
+        names += list(EXTRA_SHAPES)
+    names.append("eval_path")
+    if platform == "tpu":
+        # Last: the heaviest section, so a wall-clock kill costs least.
+        names.append("ab_p256")
+    return names
 
-    for label, bench_model, bs, n1, n2, pad, remat in shapes:
+
+def _run_bucket_section(label: str, ctx, detail) -> None:
+    import jax
+
+    from deepinteract_tpu.training.optim import OptimConfig
+    from deepinteract_tpu.training.steps import create_train_state
+
+    if label in BUCKET_SHAPES:
+        bs, n1, n2, pad, remat = BUCKET_SHAPES[label]
+        bench_model = ctx["make_model"](remat=remat)
+        extra = False
+    else:
+        bs, n1, n2, pad, remat = EXTRA_SHAPES[label]
+        extra = True
+        if label == "b1_p128_deeplab":
+            if ctx["bench_dtype"] != "float32":
+                detail["buckets"][label] = {
+                    "skipped": "deeplab path is float32-only"}
+                return
+            bench_model = ctx["make_extra"](interact_module_type="deeplab")
+        elif label == "b1_p384_tiled":
+            bench_model = ctx["make_extra"](tile_pair_map=True, tile_size=128,
+                                            node_count_limit=4096)
+        else:  # b1_p512_tiled — 2x the reference's 256-residue cap
+            bench_model = ctx["make_extra"](tile_pair_map=True,
+                                            node_count_limit=4096)
+
+    entry = None
+    for attempt in range(2):
         try:
             batch = _make_batch(bs, n1, n2, pad)
             state = create_train_state(
@@ -425,179 +507,253 @@ def main() -> None:
                 optim_cfg=OptimConfig(steps_per_epoch=100, num_epochs=50),
             )
             entry = bench_bucket(bench_model, state, batch, label, detail,
-                                 remat, scan_k)
-        except Exception as exc:  # one bucket failing must not kill the run
-            msg = str(exc).splitlines()[0][:300] if str(exc) else repr(exc)
-            if "error" not in detail["buckets"].get(label, {}):
-                # Keep richer diagnostics (e.g. the MFU guard's
-                # rejected_entry) if the bucket already recorded them.
-                detail["buckets"][label] = {"error": msg}
-            _log(json.dumps({label: {"error": msg}}))
-            if label == "b1_p128":
-                # The stdout contract line must appear even when the
-                # headline bucket fails: emit value 0 so the driver records
-                # a failed measurement instead of an empty file.
-                print(json.dumps({
-                    "metric": f"train_complexes_per_sec_b1_p128_scan{scan_k}",
-                    "value": 0.0, "unit": "complexes/s", "vs_baseline": 0.0,
-                }), flush=True)
+                                 remat, ctx["scan_k"])
+            break
+        except Exception as exc:
+            if attempt == 1 or not _is_transient(exc):
+                raise
+            _log(f"{label}: transient failure, retrying bucket: "
+                 f"{str(exc).splitlines()[0][:200]}")
+    if extra and entry is not None:
+        # analytic_forward_flops models the dilated stack; for these
+        # alternative architectures it is indicative only.
+        detail["buckets"][label]["analytic_note"] = (
+            "analytic FLOPs assume the dilated decoder")
+
+
+def _run_ab_section(pad: int, ctx, detail) -> None:
+    """Pallas-vs-jnp A/B at one bucket: forced impls so 'auto' heuristics
+    cannot hide a regression; measured on forward + train step."""
+    import jax
+
+    from deepinteract_tpu.ops.pallas_attention import supports
+    from deepinteract_tpu.training.optim import OptimConfig
+    from deepinteract_tpu.training.steps import create_train_state, train_step
+
+    n1, n2 = {128: (100, 80), 256: (230, 200)}[pad]
+    key = f"attention_ab_b1_p{pad}"
+    ab = {}
+    for impl in ("jnp", "pallas"):
+        if impl == "pallas" and not supports(pad):
+            ab["pallas"] = {"skipped": f"kernel does not support pad {pad}"}
             continue
-
-        if label == "b1_p128":
-            headline = entry
-            # Emit the contract line as soon as the headline bucket is done:
-            # later buckets may exceed the driver's wall-clock budget on a
-            # cold compile cache, and the stdout line must not be lost.
-            # Headline = scanned train throughput (what a real training run
-            # sustains). The pre-scan per-dispatch figure is carried as a
-            # compatibility key so cross-round consumers keep an
-            # apples-to-apples per-step series (ADVICE r2).
-            if "train_scan_complexes_per_sec" in entry:
-                value = entry["train_scan_complexes_per_sec"]
-                metric = f"train_complexes_per_sec_b1_p128_scan{scan_k}"
-            else:
-                value = entry["train_complexes_per_sec"]
-                metric = "train_step_complexes_per_sec_b1_p128"
-            print(json.dumps({
-                "metric": metric,
-                "value": round(value, 2),
-                "unit": "complexes/s",
-                "vs_baseline": round(value / CPU_BASELINE_COMPLEXES_PER_SEC, 2),
-                # compatibility series (per-dispatch step, not scanned)
-                "train_step_complexes_per_sec_b1_p128":
-                    round(entry["train_complexes_per_sec"], 2),
-                "analytic_train_mfu": round(entry["analytic_train_mfu"], 4),
-            }), flush=True)
-
-    # Pallas-vs-jnp A/B on the TPU at the headline bucket and at the
-    # reference's 256-residue regime (the kernel's new edge-block grid).
-    # Forced impls so 'auto' heuristics cannot hide a regression; measured
-    # on forward + train step.
-    if dev.platform == "tpu" and not os.environ.get("DI_BENCH_FAST"):
-        for pad, (n1, n2) in ((128, (100, 80)), (256, (230, 200))):
-            key = f"attention_ab_b1_p{pad}"
-            try:
-                from deepinteract_tpu.ops.pallas_attention import supports
-
-                ab = {}
-                for impl in ("jnp", "pallas"):
-                    if impl == "pallas" and not supports(pad):
-                        ab["pallas"] = {"skipped": f"kernel does not support pad {pad}"}
-                        continue
-                    m = make_model(attention_impl=impl)
-                    batch = _make_batch(1, n1, n2, pad)
-                    state = create_train_state(
-                        m, batch, optim_cfg=OptimConfig(steps_per_epoch=100,
-                                                        num_epochs=50),
-                    )
-                    from deepinteract_tpu.training.steps import train_step as _ts
-
-                    fwd = jax.jit(
-                        lambda params, bstats, b, _m=m: _m.apply(
-                            {"params": params, "batch_stats": bstats},
-                            b.graph1, b.graph2, train=False,
-                        )
-                    )
-                    _, ft, _ = _time_compiled(
-                        fwd, (state.params, state.batch_stats, batch))
-                    tstep = jax.jit(lambda s, b: _ts(s, b))
-                    _, tt, _ = _time_compiled(tstep, (state, batch))
-                    ab[impl] = {"forward_ms": ft["median"] * 1e3,
-                                "train_ms": tt["median"] * 1e3}
-                if "forward_ms" in ab.get("pallas", {}):
-                    ab["pallas_speedup_forward"] = (
-                        ab["jnp"]["forward_ms"] / ab["pallas"]["forward_ms"])
-                    ab["pallas_speedup_train"] = (
-                        ab["jnp"]["train_ms"] / ab["pallas"]["train_ms"])
-                detail[key] = ab
-                _log(json.dumps({key: ab}))
-            except Exception as exc:
-                detail[key] = {"error": str(exc).splitlines()[0][:300]}
-
-    # Optional extra shapes (DI_BENCH_EXTRA=1): the long-context tiled
-    # decoder and the DeepLabV3+ alternative — not part of the driver's
-    # budgeted run, measured on demand for BASELINE.md coverage.
-    if os.environ.get("DI_BENCH_EXTRA"):
-        def make_extra(**overrides):
-            base = ModelConfig(
-                gnn=dataclasses.replace(
-                    ModelConfig().gnn,
-                    node_count_limit=overrides.pop("node_count_limit", 2304)),
-                decoder=dataclasses.replace(
-                    ModelConfig().decoder, compute_dtype=bench_dtype),
+        # p256 train needs decoder remat (same HBM constraint as the
+        # b1_p256 bucket; without it the step OOMs).
+        m = ctx["make_model"](remat=(pad >= 256), attention_impl=impl)
+        batch = _make_batch(1, n1, n2, pad)
+        state = create_train_state(
+            m, batch,
+            optim_cfg=OptimConfig(steps_per_epoch=100, num_epochs=50),
+        )
+        fwd = jax.jit(
+            lambda params, bstats, b, _m=m: _m.apply(
+                {"params": params, "batch_stats": bstats},
+                b.graph1, b.graph2, train=False,
             )
-            return DeepInteract(dataclasses.replace(base, **overrides))
+        )
+        _, ft, _ = _time_compiled(fwd, (state.params, state.batch_stats, batch))
+        tstep = jax.jit(lambda s, b: train_step(s, b))
+        _, tt, _ = _time_compiled(tstep, (state, batch))
+        ab[impl] = {"forward_ms": ft["median"] * 1e3,
+                    "train_ms": tt["median"] * 1e3}
+    if "forward_ms" in ab.get("pallas", {}):
+        ab["pallas_speedup_forward"] = (
+            ab["jnp"]["forward_ms"] / ab["pallas"]["forward_ms"])
+        ab["pallas_speedup_train"] = (
+            ab["jnp"]["train_ms"] / ab["pallas"]["train_ms"])
+    detail[key] = ab
+    _log(json.dumps({key: ab}))
 
-        for label, mk in (
-            ("b1_p384_tiled",  # 3x3 grid of 128-tiles
-             lambda: make_extra(tile_pair_map=True, tile_size=128,
-                                node_count_limit=4096)),
-            ("b1_p512_tiled",  # 2x the reference's 256-residue cap
-             lambda: make_extra(tile_pair_map=True, node_count_limit=4096)),
-            ("b1_p128_deeplab",
-             lambda: make_extra(interact_module_type="deeplab")
-             if bench_dtype == "float32" else None),
-        ):
+
+def _run_eval_section(ctx, detail) -> None:
+    """Eval-path throughput: per-complex dispatch vs batched + scanned eval
+    (VERDICT r2 item 6). DIPS-Plus validation is 3,548 complexes/epoch, so
+    this ratio is val-epoch wall time."""
+    import jax
+
+    from deepinteract_tpu.training.optim import OptimConfig
+    from deepinteract_tpu.training.steps import (
+        create_train_state,
+        eval_step,
+        multi_eval_step,
+        stack_microbatches,
+    )
+
+    model = ctx["make_model"]()
+    state = create_train_state(
+        model, _make_batch(1, 100, 80, 128),
+        optim_cfg=OptimConfig(steps_per_epoch=100, num_epochs=50),
+    )
+    b1 = _make_batch(1, 100, 80, 128)
+    es = jax.jit(lambda s, b: eval_step(s, b))
+    _, et1, _ = _time_compiled(es, (state, b1))
+    b8 = _make_batch(8, 100, 80, 128)
+    stacked = stack_microbatches([b8] * 8)
+    mes = jax.jit(lambda s, bs: multi_eval_step(s, bs))
+    _, et64, _ = _time_compiled(mes, (state, stacked),
+                                iters=max(ITERS // 4, 3), reps=min(REPS, 3))
+    ev = {
+        "eval_b1_ms": et1["median"] * 1e3,
+        "eval_b1_complexes_per_sec": 1.0 / et1["median"],
+        "eval_b8_scan8_ms_per_complex": et64["median"] * 1e3 / 64,
+        "eval_b8_scan8_complexes_per_sec": 64.0 / et64["median"],
+        "speedup": (64.0 / et64["median"]) / (1.0 / et1["median"]),
+    }
+    detail["eval_path_b128"] = ev
+    _log(json.dumps({"eval_path_b128": ev}))
+
+
+def _section_result_key(name: str):
+    """Where a section's result (or error) lives in the detail dict:
+    (container, key). Buckets nest under 'buckets'; the A/B and eval
+    sections use the same top-level keys their successes always used."""
+    if name == "eval_path":
+        return None, "eval_path_b128"
+    if name.startswith("ab_p"):
+        return None, f"attention_ab_b1_p{name[4:]}"
+    return "buckets", name
+
+
+def _record_section_error(detail, name: str, msg: str) -> None:
+    container, key = _section_result_key(name)
+    target = detail[container] if container else detail
+    if "error" not in target.get(key, {}):
+        target[key] = {"error": msg}
+    _log(json.dumps({key: {"error": msg}}))
+
+
+def _run_section(name: str, ctx, detail) -> None:
+    if name == "eval_path":
+        _run_eval_section(ctx, detail)
+    elif name.startswith("ab_p"):
+        _run_ab_section(int(name[4:]), ctx, detail)
+    else:
+        _run_bucket_section(name, ctx, detail)
+
+
+def _emit_headline(detail, scan_k) -> None:
+    """Print the ONE stdout contract line from the b1_p128 result (or a
+    value-0 line when the headline bucket failed, so the driver records a
+    failed measurement instead of an empty file). Headline = scanned train
+    throughput (what a real training run sustains); the per-dispatch step
+    figure rides along as a compatibility key (ADVICE r2)."""
+    entry = detail["buckets"].get("b1_p128", {})
+    if "train_scan_complexes_per_sec" in entry:
+        value = entry["train_scan_complexes_per_sec"]
+        metric = f"train_complexes_per_sec_b1_p128_scan{scan_k}"
+    elif "train_complexes_per_sec" in entry:
+        value = entry["train_complexes_per_sec"]
+        metric = "train_step_complexes_per_sec_b1_p128"
+    else:
+        print(json.dumps({
+            "metric": f"train_complexes_per_sec_b1_p128_scan{scan_k}",
+            "value": 0.0, "unit": "complexes/s", "vs_baseline": 0.0,
+        }), flush=True)
+        return
+    line = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "complexes/s",
+        "vs_baseline": round(value / CPU_BASELINE_COMPLEXES_PER_SEC, 2),
+        "train_step_complexes_per_sec_b1_p128":
+            round(entry["train_complexes_per_sec"], 2),
+    }
+    if "analytic_train_mfu" in entry:
+        line["analytic_train_mfu"] = round(entry["analytic_train_mfu"], 4)
+    print(json.dumps(line), flush=True)
+
+
+def _merge_fragment(detail, fragment) -> None:
+    for k, v in fragment.items():
+        if k == "buckets":
+            detail["buckets"].update(v)
+        else:
+            detail[k] = v
+
+
+def _run_sections_isolated(names, detail, scan_k) -> None:
+    """Run each section in a FRESH subprocess. The axon tunnel's remote
+    compile helper degrades within long-lived client processes (observed:
+    p256 compiles return HTTP 500 after a few large compiles in the same
+    process but succeed from a fresh one), so process isolation is the
+    reliable way to get every bucket. Also bounds each section's wall time
+    and shields the run from a single section crashing the interpreter."""
+    import subprocess
+    import tempfile
+
+    timeout_s = float(os.environ.get("DI_BENCH_SECTION_TIMEOUT", "1500"))
+    for name in names:
+        frag = None
+        with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as fh:
+            out_path = fh.name
+        env = dict(os.environ,
+                   DI_BENCH_SECTION=name, DI_BENCH_OUT=out_path)
+        err = None
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, timeout=timeout_s,
+                stdout=subprocess.DEVNULL, stderr=None,
+            )
+            # A child killed before json.dump leaves an empty file; keep
+            # the exit code as the diagnostic rather than a JSON error.
+            if os.path.getsize(out_path) > 0:
+                with open(out_path) as fh:
+                    frag = json.load(fh)
+            else:
+                err = f"section exited rc={proc.returncode} with no output"
+        except subprocess.TimeoutExpired:
+            err = f"section timed out after {timeout_s:.0f}s"
+        except Exception as exc:
+            err = str(exc).splitlines()[0][:300]
+        finally:
             try:
-                m = mk()
-                if m is None:
-                    detail["buckets"][label] = {
-                        "skipped": "deeplab path is float32-only"}
-                    continue
-                pad = 384 if "384" in label else 512 if "512" in label else 128
-                n1, n2 = {384: (370, 350), 512: (500, 470),
-                          128: (100, 80)}[pad]
-                batch = _make_batch(1, n1, n2, pad)
-                state = create_train_state(
-                    m, batch, optim_cfg=OptimConfig(steps_per_epoch=100,
-                                                    num_epochs=50),
-                )
-                bench_bucket(m, state, batch, label, detail,
-                             remat=False, scan_k=scan_k)
-                # analytic_forward_flops models the dilated stack; for
-                # these alternative architectures it is indicative only.
-                detail["buckets"][label]["analytic_note"] = (
-                    "analytic FLOPs assume the dilated decoder")
+                os.unlink(out_path)
+            except OSError:
+                pass
+        if frag:
+            _merge_fragment(detail, frag)
+        elif err:
+            _record_section_error(detail, name, err)
+        if name == "b1_p128":
+            _emit_headline(detail, scan_k)
+
+
+def main() -> None:
+    section = os.environ.get("DI_BENCH_SECTION")
+    ctx = _setup()
+    detail = {"backend": ctx["dev"].platform,
+              "device_kind": ctx["dev"].device_kind,
+              "iters": ITERS, "reps": REPS,
+              "compute_dtype": ctx["bench_dtype"], "buckets": {}}
+    scan_k = ctx["scan_k"]
+
+    if section:
+        # Child mode: run ONE section, dump the detail fragment, print
+        # nothing on stdout (the parent owns the contract line).
+        try:
+            _run_section(section, ctx, detail)
+        except Exception as exc:
+            msg = str(exc).splitlines()[0][:300] if str(exc) else repr(exc)
+            _record_section_error(detail, section, msg)
+        out = os.environ.get("DI_BENCH_OUT")
+        if out:
+            with open(out, "w") as fh:
+                json.dump(detail, fh)
+        return
+
+    names = _section_names(ctx["dev"].platform)
+    if os.environ.get("DI_BENCH_INLINE"):
+        for name in names:
+            try:
+                _run_section(name, ctx, detail)
             except Exception as exc:
                 msg = str(exc).splitlines()[0][:300] if str(exc) else repr(exc)
-                detail["buckets"][label] = {"error": msg}
-                _log(json.dumps({label: detail["buckets"][label]}))
-
-    # Eval-path throughput: the per-complex dispatch the r2 Trainer used vs
-    # the batched + scanned eval (VERDICT r2 item 6). DIPS-Plus validation
-    # is 3,548 complexes/epoch, so this ratio is val-epoch wall time.
-    if not os.environ.get("DI_BENCH_FAST"):
-        try:
-            from deepinteract_tpu.training.steps import (
-                eval_step,
-                multi_eval_step,
-                stack_microbatches,
-            )
-
-            state = create_train_state(
-                model, _make_batch(1, 100, 80, 128),
-                optim_cfg=OptimConfig(steps_per_epoch=100, num_epochs=50),
-            )
-            b1 = _make_batch(1, 100, 80, 128)
-            es = jax.jit(lambda s, b: eval_step(s, b))
-            _, et1, _ = _time_compiled(es, (state, b1))
-            b8 = _make_batch(8, 100, 80, 128)
-            stacked = stack_microbatches([b8] * 8)
-            mes = jax.jit(lambda s, bs: multi_eval_step(s, bs))
-            _, et64, _ = _time_compiled(mes, (state, stacked),
-                                        iters=max(ITERS // 4, 3),
-                                        reps=min(REPS, 3))
-            ev = {
-                "eval_b1_ms": et1["median"] * 1e3,
-                "eval_b1_complexes_per_sec": 1.0 / et1["median"],
-                "eval_b8_scan8_ms_per_complex": et64["median"] * 1e3 / 64,
-                "eval_b8_scan8_complexes_per_sec": 64.0 / et64["median"],
-                "speedup": (64.0 / et64["median"]) / (1.0 / et1["median"]),
-            }
-            detail["eval_path_b128"] = ev
-            _log(json.dumps({"eval_path_b128": ev}))
-        except Exception as exc:
-            detail["eval_path_b128"] = {"error": str(exc).splitlines()[0][:300]}
+                _record_section_error(detail, name, msg)
+            if name == "b1_p128":
+                _emit_headline(detail, scan_k)
+    else:
+        _run_sections_isolated(names, detail, scan_k)
 
     detail["cpu_baseline_complexes_per_sec"] = CPU_BASELINE_COMPLEXES_PER_SEC
     detail["peak_flops_assumed"] = PEAK_FLOPS

@@ -79,6 +79,7 @@ def main(argv=None) -> int:
     train_loader = BucketedLoader(
         dm.train, batch_size=args.batch_size, shuffle=True, drop_remainder=True,
         seed=args.seed, pad_to_max_bucket=args.pad_to_max_bucket, shard=shard,
+        dispatch_run=max(1, args.steps_per_dispatch),
     )
     if shard:
         print(f"host {shard[0]}/{shard[1]}: {train_loader.num_batches()} "

@@ -46,6 +46,7 @@ class BucketedLoader:
         pad_to_max_bucket: bool = False,
         prefetch: int = 2,
         shard: Optional[Tuple[int, int]] = None,
+        dispatch_run: int = 1,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -53,6 +54,18 @@ class BucketedLoader:
         self.drop_remainder = drop_remainder
         self.seed = seed
         self.pad_to_max_bucket = pad_to_max_bucket
+        # Shuffle granularity: with dispatch_run > 1 the epoch plan keeps
+        # runs of up to this many consecutive SAME-bucket batches together
+        # and shuffles at run granularity instead of batch granularity.
+        # The Trainer's scanned K-step dispatch only engages on runs of >=
+        # K same-shape batches (training/loop.py:_shape_runs); a fully
+        # interleaved shuffle makes expected run length ~#buckets/(#buckets
+        # -1) and silently degrades every step to the un-amortized
+        # single-dispatch path (measured: 2.5x epoch slowdown on a mixed
+        # 128/256 corpus, tools/sustained_train.py r4). Deviation from the
+        # reference's unconstrained shuffle, by design: complexes are still
+        # shuffled within buckets and run order is shuffled every epoch.
+        self.dispatch_run = max(1, dispatch_run)
         # Batches ready ahead of the consumer on a background thread
         # (npz load + pad + stack overlap device compute; 0 disables).
         self.prefetch = prefetch
@@ -119,7 +132,22 @@ class BucketedLoader:
                             k += 1
                 plan.append((bucket, chunk))
         if rng:
-            rng.shuffle(plan)  # interleave buckets across the epoch
+            if self.dispatch_run > 1:
+                # Run-granular shuffle: split each bucket's (contiguous)
+                # batches into runs of dispatch_run, shuffle the runs.
+                runs = []
+                i = 0
+                while i < len(plan):
+                    j = i
+                    while (j < len(plan) and plan[j][0] == plan[i][0]
+                           and j - i < self.dispatch_run):
+                        j += 1
+                    runs.append(plan[i:j])
+                    i = j
+                rng.shuffle(runs)
+                plan = [entry for run in runs for entry in run]
+            else:
+                rng.shuffle(plan)  # interleave buckets across the epoch
         return plan
 
     def _host_slice(self, chunk: List[int]) -> List[int]:

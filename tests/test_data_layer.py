@@ -321,3 +321,38 @@ def test_prefetch_worker_stops_on_abandonment():
         time.sleep(0.05)
     assert threading.active_count() <= before
     assert len(produced) < 100  # worker stopped early, not drained
+
+
+def test_bucketed_loader_dispatch_run_grouping(rng):
+    """dispatch_run=K shuffles at run granularity: the epoch plan keeps
+    runs of up to K consecutive same-bucket batches (so the Trainer's
+    K-step scanned dispatch engages), while content and within-bucket
+    shuffling are preserved."""
+    raws = ([make_raw_complex(20, 16, rng) for _ in range(16)]
+            + [make_raw_complex(70, 80, rng) for _ in range(16)])
+    ds = InMemoryDataset(raws)
+    K = 4
+    loader = BucketedLoader(ds, batch_size=1, shuffle=True, seed=3,
+                            dispatch_run=K)
+    for epoch in (0, 1):
+        plan = loader._epoch_plan(epoch)
+        shapes = [b for b, _ in plan]
+        assert len(plan) == 32
+        # Count run lengths of consecutive equal shapes.
+        runs, i = [], 0
+        while i < len(shapes):
+            j = i
+            while j < len(shapes) and shapes[j] == shapes[i]:
+                j += 1
+            runs.append(j - i)
+            i = j
+        # Every maximal run is composed of K-sized planned runs; with 16
+        # batches per bucket all planned runs are complete, so every
+        # maximal run length is a multiple of K.
+        assert all(r % K == 0 for r in runs), runs
+        assert max(runs) >= K
+    # Epochs reshuffle run order but preserve content.
+    p0 = [idx for _, chunk in loader._epoch_plan(0) for idx in chunk]
+    p1 = [idx for _, chunk in loader._epoch_plan(1) for idx in chunk]
+    assert sorted(p0) == sorted(p1) == list(range(32))
+    assert p0 != p1
