@@ -516,8 +516,17 @@ class Trainer:
                 # construction in _device_stacked.
                 state, stacked = self._multi_step(
                     state, self._device_stacked(stack_microbatches(run)))
+                # ONE host fetch per metric leaf per dispatch: per-step
+                # slicing of the device array (m[j] then float()) costs a
+                # device round trip PER MICROBATCH, which at K=8 through a
+                # remote-device tunnel dominates the logging path
+                # (measured, tools/sustained_train.py r4).
+                stacked_host = {
+                    k: np.asarray(host_local_array(v))
+                    for k, v in stacked.items()
+                }
                 for j in range(len(run)):
-                    log_step(jax.tree_util.tree_map(lambda m: m[j], stacked))
+                    log_step({k: v[j] for k, v in stacked_host.items()})
         return state
 
     def _device_batch(self, batch: PairedComplex) -> PairedComplex:

@@ -36,3 +36,18 @@ import pytest  # noqa: E402
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled executables after each test module.
+
+    The full suite compiles hundreds of XLA CPU executables (including the
+    512x384 sharded train step); jax's global pjit cache keeps them all
+    alive, and by ~90% of the suite a native compile segfaults under the
+    accumulated memory pressure (observed twice, r4). Per-module cache
+    clearing bounds the footprint; cross-module recompiles are rare since
+    modules use different shapes anyway.
+    """
+    yield
+    jax.clear_caches()
