@@ -38,6 +38,15 @@ def add_data_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--batch_size", type=int, default=1)
     g.add_argument("--pad_to_max_bucket", action="store_true",
                    help="pad every chain to the top bucket (one compile)")
+    g.add_argument("--diagonal_buckets", action="store_true",
+                   help="pad both chains of a pair to the larger chain's "
+                        "bucket: at most L shape-pair compiles instead of "
+                        "L^2 and longer scanned runs, at extra pad cost "
+                        "for asymmetric pairs")
+    g.add_argument("--packed_cache_dir", type=str, default=None,
+                   help="directory for pre-padded per-bucket memmap packs "
+                        "(built on first run); makes the per-epoch host "
+                        "path an mmap+stack instead of npz decompress+pad")
 
 
 def add_model_args(p: argparse.ArgumentParser) -> None:

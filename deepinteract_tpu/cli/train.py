@@ -76,17 +76,51 @@ def main(argv=None) -> int:
         (jax.process_index(), jax.process_count())
         if jax.process_count() > 1 else None
     )
+    train_ds, val_ds, test_ds = dm.train, dm.val, dm.test
+    if args.packed_cache_dir:
+        # Pre-padded memmap packs (data/packed.py): built once (first run
+        # pays one pass over the npz tree), then every epoch's host path
+        # is mmap + stack. Pack-time buckets use the same flags as the
+        # loaders below.
+        import os as _os
+
+        from deepinteract_tpu.data.loader import make_bucket_fn
+        from deepinteract_tpu.data.packed import PackedDataset, pack_dataset
+
+        bucket_fn = make_bucket_fn(args.pad_to_max_bucket,
+                                   args.diagonal_buckets)
+        eval_bucket_fn = make_bucket_fn(False, False)
+        train_sig = (f"pad_max={args.pad_to_max_bucket},"
+                     f"diag={args.diagonal_buckets}")
+        specs = (("train", train_ds, bucket_fn, train_sig),
+                 ("val", val_ds, eval_bucket_fn, "eval"),
+                 ("test", test_ds, eval_bucket_fn, "eval"))
+        # Multi-host: only process 0 writes the pack (concurrent writers
+        # on shared storage would corrupt it); everyone else waits at the
+        # barrier and then reads it.
+        if jax.process_index() == 0:
+            for split, ds, fn, sig in specs:
+                pack_dataset(ds, _os.path.join(args.packed_cache_dir, split),
+                             fn, signature=sig)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("packed_cache_built")
+        train_ds, val_ds, test_ds = (
+            PackedDataset(_os.path.join(args.packed_cache_dir, split))
+            for split, *_ in specs)
     train_loader = BucketedLoader(
-        dm.train, batch_size=args.batch_size, shuffle=True, drop_remainder=True,
+        train_ds, batch_size=args.batch_size, shuffle=True, drop_remainder=True,
         seed=args.seed, pad_to_max_bucket=args.pad_to_max_bucket, shard=shard,
         dispatch_run=max(1, args.steps_per_dispatch),
+        diagonal_buckets=args.diagonal_buckets,
     )
     if shard:
         print(f"host {shard[0]}/{shard[1]}: {train_loader.num_batches()} "
               f"coordinated global steps/epoch, {args.batch_size} local x "
               f"{shard[1]} hosts per step")
-    val_loader = BucketedLoader(dm.val, batch_size=args.eval_batch_size)
-    test_loader = BucketedLoader(dm.test, batch_size=args.eval_batch_size)
+    val_loader = BucketedLoader(val_ds, batch_size=args.eval_batch_size)
+    test_loader = BucketedLoader(test_ds, batch_size=args.eval_batch_size)
 
     # Calibrate the cosine-restart schedule on the actual epoch length
     # (reference T_0=10 epochs, deepinteract_modules.py:2196).

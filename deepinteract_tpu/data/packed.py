@@ -1,0 +1,204 @@
+"""Pre-padded per-bucket memmap pack: batch assembly as mmap + stack.
+
+The per-item host path (npz decompress -> pad -> re-layout in
+``to_paired_complex``) runs on the data-loading core and was measured as a
+main contributor to the sustained-training gap (3.1 c/s sustained vs ~7.5
+predicted from device step times, BASELINE.md r4; VERDICT r4 item 3): the
+reference hides the equivalent cost behind a dozen DataLoader worker
+processes (``num_dataloader_workers``, project/utils/
+deepinteract_utils.py:1070-1099), which a one-core host cannot.
+
+A pack stores every complex ALREADY PADDED to its shape bucket, one
+``.npy`` per pytree leaf per bucket, written once by :func:`pack_dataset`.
+Batch assembly then is ``np.stack`` over rows of ``np.load(...,
+mmap_mode='r')`` arrays — no decompression, no padding, no re-layout, and
+the OS page cache absorbs re-reads across epochs. ``BucketedLoader``
+detects a :class:`PackedDataset` by its ``padded_batch`` method and uses
+the pack's stored buckets for planning, so batches are bit-identical to
+the unpacked path (same ``to_paired_complex`` output, stacked).
+
+Storage cost: pad ratio x raw size (a p128-bucket complex stores its full
+128-row layout). That trade is the point — disk for host CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+INDEX_NAME = "pack_index.json"
+_PACK_VERSION = 1
+
+
+def _treedef():
+    """Flattening structure of a PairedComplex (registered dataclasses
+    flatten in field order, so this is stable across processes)."""
+    import jax
+
+    from deepinteract_tpu.data.graph import PairedComplex, ProteinGraph
+
+    dummy_graph = ProteinGraph(*([0] * 8))
+    dummy = PairedComplex(dummy_graph, dummy_graph, 0, 0, 0)
+    return jax.tree_util.tree_structure(dummy)
+
+
+def _bucket_key(bucket: Tuple[int, int]) -> str:
+    return f"{bucket[0]}x{bucket[1]}"
+
+
+def _leaf_path(out_dir: str, bucket: Tuple[int, int], leaf_idx: int) -> str:
+    return os.path.join(out_dir, f"bucket_{_bucket_key(bucket)}_leaf{leaf_idx}.npy")
+
+
+def pack_dataset(dataset, out_dir: str, item_bucket_fn,
+                 signature: str = "") -> str:
+    """Write ``dataset`` as a pre-padded pack under ``out_dir``.
+
+    ``item_bucket_fn(n1, n2) -> (b1, b2)`` decides each complex's bucket —
+    pass the owning loader's ``_item_bucket`` so pack-time buckets match
+    plan-time buckets (diagonal/max-bucket modes included). ``signature``
+    should encode the bucket-fn flags (and anything else that changes pack
+    content): an existing index is reused ONLY when version, signature,
+    item count AND the per-item length list all match — a pack built
+    under different flags or over changed data is rebuilt, not silently
+    served stale.
+    """
+    import jax
+
+    from deepinteract_tpu.data.io import to_paired_complex
+
+    index_path = os.path.join(out_dir, INDEX_NAME)
+    lengths = list(dataset.lengths())
+    if os.path.exists(index_path):
+        with open(index_path) as fh:
+            existing = json.load(fh)
+        if (existing.get("version") == _PACK_VERSION
+                and existing.get("signature", "") == signature
+                and existing.get("num_items") == len(lengths)
+                and existing.get("lengths")
+                == [list(map(int, ln)) for ln in lengths]):
+            return out_dir
+    os.makedirs(out_dir, exist_ok=True)
+
+    groups: Dict[Tuple[int, int], List[int]] = defaultdict(list)
+    for idx, (n1, n2) in enumerate(lengths):
+        groups[tuple(item_bucket_fn(n1, n2))].append(idx)
+
+    index = {
+        "version": _PACK_VERSION,
+        "signature": signature,
+        "num_items": len(lengths),
+        "lengths": [list(map(int, ln)) for ln in lengths],
+        "targets": [str(dataset.target_of(i)) for i in range(len(lengths))],
+        "buckets": {},
+    }
+    for bucket, idxs in sorted(groups.items()):
+        writers = None
+        for row, idx in enumerate(idxs):
+            raw = dataset[idx]
+            pc = to_paired_complex(
+                raw, n_pad1=bucket[0], n_pad2=bucket[1],
+                input_indep=raw.get("input_indep", False),
+            )
+            leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(pc)]
+            if writers is None:
+                writers = [
+                    np.lib.format.open_memmap(
+                        _leaf_path(out_dir, bucket, i), mode="w+",
+                        dtype=leaf.dtype, shape=(len(idxs),) + leaf.shape,
+                    )
+                    for i, leaf in enumerate(leaves)
+                ]
+            for w, leaf in zip(writers, leaves):
+                w[row] = leaf
+        for w in writers:
+            w.flush()
+        index["buckets"][_bucket_key(bucket)] = {
+            "bucket": list(bucket),
+            "indices": idxs,
+            "num_leaves": len(writers),
+        }
+    with open(index_path + ".tmp", "w") as fh:
+        json.dump(index, fh)
+    os.replace(index_path + ".tmp", index_path)
+    return out_dir
+
+
+class PackedDataset:
+    """Loader-facing view of a pack directory.
+
+    Implements the dataset protocol pieces ``BucketedLoader`` consumes
+    (``lengths``/``target_of``/``__len__``) plus the fast-path methods the
+    loader prefers when present: ``bucket_of(idx)`` (plan with pack-time
+    buckets) and ``padded_batch(indices, bucket)`` (mmap + stack).
+    """
+
+    def __init__(self, pack_dir: str):
+        self.pack_dir = pack_dir
+        with open(os.path.join(pack_dir, INDEX_NAME)) as fh:
+            self._index = json.load(fh)
+        if self._index.get("version") != _PACK_VERSION:
+            raise ValueError(
+                f"pack version {self._index.get('version')} != {_PACK_VERSION}"
+            )
+        self._lengths = [tuple(ln) for ln in self._index["lengths"]]
+        self._targets = list(self._index["targets"])
+        # idx -> (bucket, row-in-bucket)
+        self._where: Dict[int, Tuple[Tuple[int, int], int]] = {}
+        for info in self._index["buckets"].values():
+            bucket = tuple(info["bucket"])
+            for row, idx in enumerate(info["indices"]):
+                self._where[idx] = (bucket, row)
+        self._mmaps: Dict[Tuple[int, int], List[np.ndarray]] = {}
+        self._td = _treedef()
+
+    def __len__(self) -> int:
+        return self._index["num_items"]
+
+    def lengths(self) -> List[tuple]:
+        return list(self._lengths)
+
+    def target_of(self, idx: int) -> str:
+        return self._targets[idx]
+
+    def bucket_of(self, idx: int) -> Tuple[int, int]:
+        return self._where[idx][0]
+
+    def _bucket_mmaps(self, bucket: Tuple[int, int]) -> List[np.ndarray]:
+        if bucket not in self._mmaps:
+            n = self._index["buckets"][_bucket_key(bucket)]["num_leaves"]
+            self._mmaps[bucket] = [
+                np.load(_leaf_path(self.pack_dir, bucket, i), mmap_mode="r")
+                for i in range(n)
+            ]
+        return self._mmaps[bucket]
+
+    def padded_batch(self, indices: Sequence[int], bucket: Tuple[int, int]):
+        """Stacked ``PairedComplex`` batch for ``indices`` (all in
+        ``bucket``) — equivalent to per-item ``to_paired_complex`` +
+        ``stack_complexes`` by construction of the pack."""
+        import jax
+
+        bucket = tuple(bucket)
+        rows = []
+        for idx in indices:
+            b, row = self._where[idx]
+            if b != bucket:
+                raise ValueError(
+                    f"item {idx} packed for bucket {b}, requested {bucket} — "
+                    "loader bucket rules must match pack-time rules"
+                )
+            rows.append(row)
+        mmaps = self._bucket_mmaps(bucket)
+        leaves = [np.stack([mm[r] for r in rows]) for mm in mmaps]
+        return jax.tree_util.tree_unflatten(self._td, leaves)
+
+    def __getitem__(self, idx: int):
+        raise TypeError(
+            "PackedDataset items are pre-padded; iterate through "
+            "BucketedLoader (padded_batch), not per-item raw dicts"
+        )

@@ -86,15 +86,12 @@ def masked_instance_norm(x: jnp.ndarray, mask: Optional[jnp.ndarray], scale, bia
     in float32 (bf16 spatial sums lose too much precision); the result is
     cast back to the input dtype.
 
-    Cost note (measured, tools/decoder_ablation.py): the r3 formulation —
-    an explicit float32 copy, a two-pass mean-then-(x-mean)^2 variance, and
-    three mask-broadcast multiplies — made the 56 masked norms cost ~90 us
-    each on a v5e while the unmasked path fuses to ~free. This version
-    computes both raw moments (sum(x*m), sum(x^2*m)) as sibling reductions
-    of ONE input pass with float32 accumulation (no materialized f32 copy)
-    and uses var = E[x^2] - mu^2 (activations are O(1) post-conv, so the
-    cancellation risk is negligible next to eps=1e-6; parity tests hold at
-    their existing tolerances).
+    Cost note (measured, tools/decoder_ablation.py): masked norms cost
+    ~90 us each on a v5e while the unmasked path fuses to ~free. This is
+    the FALLBACK formulation (depad_stats=False) — the default decoder
+    uses :func:`depadded_instance_norm`, which eliminates the masked
+    reductions entirely — so the masked branch keeps the numerically
+    robust two-pass (x - mean)^2 variance (ADVICE r4 item 1).
     """
     in_dtype = x.dtype
     f32 = jnp.float32
@@ -105,33 +102,42 @@ def masked_instance_norm(x: jnp.ndarray, mask: Optional[jnp.ndarray], scale, bia
         mean = s1 / n
         var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
     else:
+        # Two-pass (x - mean)^2 variance (ADVICE r4 item 1): this is the
+        # fallback path (depad_stats=False), so numerical robustness for
+        # large-|mean| activations beats saving the second reduction.
         m = mask[..., None].astype(f32)
         xm = x.astype(f32) * m
         count = jnp.maximum(jnp.sum(m, axis=(1, 2), keepdims=True), 1.0)
-        s1 = jnp.sum(xm, axis=(1, 2), keepdims=True)
-        s2 = jnp.sum(xm * x.astype(f32), axis=(1, 2), keepdims=True)
-        mean = s1 / count
-        var = jnp.maximum(s2 / count - jnp.square(mean), 0.0)
+        mean = jnp.sum(xm, axis=(1, 2), keepdims=True) / count
+        var = jnp.sum(jnp.square((x.astype(f32) - mean)) * m,
+                      axis=(1, 2), keepdims=True) / count
     y = (x.astype(f32) - mean) * jax.lax.rsqrt(var + eps) * scale + bias
     if mask is not None:
         y = y * mask[..., None]
     return y.astype(in_dtype)
 
 
-def depadded_instance_norm(x, mask, count, pad_value, scale, bias, eps=1e-6):
-    """Exact masked instance norm WITHOUT masked reductions.
+def depadded_instance_norm(x, count, pad_value, scale, bias, eps=1e-6):
+    """Exact masked instance norm WITHOUT masked reductions or a masked
+    output — the pad-value-tracking formulation (r5).
 
     Valid when every padded pixel of ``x`` holds the same per-channel value
-    ``pad_value`` ([C], or None meaning zero): the pad contribution to the
-    raw moments is then closed-form (n_pad * pv, n_pad * pv^2) and the
-    sums run UNMASKED — which XLA fuses to near-free, while mask-broadcast
-    reductions measured ~17-30 us each on a v5e (tools/decoder_ablation.py).
-    Computes the same statistics as :func:`masked_instance_norm` up to
-    float association; the decoder's padding-invariance tests are the
-    oracle.
+    ``pad_value`` ([B, 1, 1, C] in x's dtype, or None meaning zero): the
+    pad contribution to the raw moments is then closed-form (n_pad * pv,
+    n_pad * pv^2) and the sums run UNMASKED — which XLA fuses to near-free,
+    while mask-broadcast reductions measured ~17-30 us each on a v5e
+    (tools/decoder_ablation.py). Unlike the r4 version, the output is NOT
+    re-masked; instead the value every padded pixel now holds — the same
+    affine applied to ``pad_value`` — is returned alongside, so the caller
+    keeps tracking it symbolically. Statistics match
+    :func:`masked_instance_norm` up to float association; the decoder's
+    padding-invariance tests are the oracle.
 
     count: [B, 1, 1, 1] float32 — number of valid pixels (precomputed once
     per decoder call and shared by every norm).
+
+    Returns ``(y, pad_value_out)`` with ``pad_value_out`` [B, 1, 1, C] in
+    x's dtype.
     """
     f32 = jnp.float32
     in_dtype = x.dtype
@@ -144,9 +150,16 @@ def depadded_instance_norm(x, mask, count, pad_value, scale, bias, eps=1e-6):
         s1 = s1 - n_pad * pv
         s2 = s2 - n_pad * jnp.square(pv)
     mean = s1 / count
+    # Single-pass var = E[x^2] - mu^2: post-conv activations are O(1)-mean
+    # so cancellation is negligible next to eps (the depad-vs-masked
+    # large-mean parity test bounds it); the plain masked path keeps the
+    # two-pass form (ADVICE r4 item 1).
     var = jnp.maximum(s2 / count - jnp.square(mean), 0.0)
-    y = (x.astype(f32) - mean) * jax.lax.rsqrt(var + eps) * scale + bias
-    return (y * mask[..., None]).astype(in_dtype)
+    rs = jax.lax.rsqrt(var + eps) * scale
+    y = (x.astype(f32) - mean) * rs + bias
+    pv_in = pad_value.astype(f32) if pad_value is not None else 0.0
+    pv_out = (pv_in - mean) * rs + bias
+    return y.astype(in_dtype), pv_out.astype(in_dtype)
 
 
 class InstanceNorm(nn.Module):
@@ -157,43 +170,19 @@ class InstanceNorm(nn.Module):
                  depad: bool = False):
         scale = self.param("scale", nn.initializers.ones, (self.features,))
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
-        if depad and mask is not None and count is not None:
-            return depadded_instance_norm(x, mask, count, pad_value,
-                                          scale, bias)
+        if depad and count is not None:
+            return depadded_instance_norm(x, count, pad_value, scale, bias)
         return masked_instance_norm(x, mask, scale, bias)
-
-
-class BiasConv1x1(nn.Module):
-    """1x1 conv with the bias vector returned alongside the output.
-
-    Param tree is identical to ``nn.Conv(features, (1, 1))`` (kernel
-    [1, 1, I, O], bias [O]) — checkpoints are interchangeable. The bias is
-    surfaced because the de-padded statistics path needs the exact value
-    padded pixels hold after this conv (input zero at pad => output ==
-    bias there)."""
-
-    features: int
-    dtype: jnp.dtype = jnp.float32
-
-    @nn.compact
-    def __call__(self, x):
-        kernel = self.param(
-            "kernel", nn.initializers.lecun_normal(),
-            (1, 1, x.shape[-1], self.features))
-        bias = self.param("bias", nn.initializers.zeros, (self.features,))
-        k = kernel.astype(self.dtype)
-        b = bias.astype(self.dtype)
-        y = jax.lax.conv_general_dilated(
-            x.astype(self.dtype), k, (1, 1), "VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
-        # Return the bias AS COMPUTED (dtype-cast): padded pixels hold this
-        # exact value, so the depad algebra must subtract the same one.
-        return y, b
 
 
 class SEBlock(nn.Module):
     """Squeeze-and-excitation over the (masked) spatial mean
-    (deepinteract_modules.py:954-970)."""
+    (deepinteract_modules.py:954-970).
+
+    With ``count`` + ``pad_value`` (the de-padded fast path) the pooled
+    mean runs unmasked with a closed-form pad correction and the call
+    returns ``(y, pad_value_out)`` — the gate applied to the tracked pad
+    value — instead of a masked tensor."""
 
     channels: int
     ratio: int = 16
@@ -203,16 +192,16 @@ class SEBlock(nn.Module):
     def __call__(self, x, mask=None, count=None, pad_value=None):
         # f32-accumulated spatial mean (like the norms) without an
         # explicit f32 copy of the activation — see masked_instance_norm's
-        # cost note. When padded pixels hold a known constant (pad_value),
-        # the mean runs unmasked with a closed-form pad correction like
-        # depadded_instance_norm.
+        # cost note.
+        depad = count is not None and pad_value is not None
         if mask is None:
             pooled = jnp.sum(x, axis=(1, 2), dtype=jnp.float32) / (
                 x.shape[1] * x.shape[2])
-        elif count is not None and pad_value is not None:
+        elif depad:
             n_pad = float(x.shape[1] * x.shape[2]) - count[:, 0, 0, :]
             s = jnp.sum(x, axis=(1, 2), dtype=jnp.float32)
-            pooled = (s - n_pad * pad_value.astype(jnp.float32)) / count[:, 0, 0, :]
+            pooled = (s - n_pad * pad_value[:, 0, 0, :].astype(jnp.float32)
+                      ) / count[:, 0, 0, :]
         else:
             m = mask[..., None].astype(jnp.float32)
             pooled = jnp.sum(x.astype(jnp.float32) * m, axis=(1, 2)) / (
@@ -220,8 +209,11 @@ class SEBlock(nn.Module):
         pooled = pooled.astype(self.dtype)
         h = nn.relu(nn.Dense(max(1, self.channels // self.ratio), dtype=self.dtype)(pooled))
         h = nn.relu(nn.Dense(self.channels, dtype=self.dtype)(h))
-        gate = nn.sigmoid(h)
-        return x * gate[:, None, None, :].astype(x.dtype)
+        gate = nn.sigmoid(h)[:, None, None, :]
+        y = x * gate.astype(x.dtype)
+        if depad:
+            return y, pad_value * gate.astype(pad_value.dtype)
+        return y
 
 
 class BottleneckBlock(nn.Module):
@@ -229,15 +221,26 @@ class BottleneckBlock(nn.Module):
     3x3 dilated - [inorm] - act - 1x1 up - SE - residual
     (reference ResNet inner loop, deepinteract_modules.py:1060-1086).
 
-    ``depad`` selects the de-padded statistics fast path (requires mask AND
-    count AND use_inorm): the block maintains the invariant that its input
-    is zero at padded pixels, so inorm_1's stats need no mask multiplies at
-    all, inorm_2's and the SE pool's pad contribution is exactly the
-    preceding 1x1 conv's bias (closed-form subtraction), and only inorm_3 —
-    after the spatially-mixing 3x3 — keeps the general masked reduction.
-    Statistics are identical up to float association (padding-invariance
-    tests are the oracle); measured ~2x faster masked-decoder forward on a
-    v5e (tools/decoder_ablation.py)."""
+    ``depad`` selects the pad-value-tracking fast path (requires mask,
+    count AND an incoming ``pad_value``): instead of re-zeroing the padded
+    region after every op, the block tracks the single per-channel value
+    all padded pixels hold ([B, 1, 1, C]) and pushes it through each op in
+    closed form — elementwise ops (elu, norm affine, SE gate, residual
+    add) apply to it directly and a 1x1 conv maps it through the SAME conv
+    module (a [B, 1, 1, C] call reusing the parameters). Every statistic
+    then runs as an UNMASKED reduction with a closed-form pad correction.
+    The only places the mask is materialized are the two multiplies around
+    the spatially-mixing 3x3 conv: before it (so padded pixels enter the
+    conv as zero — the reference's unpadded zero-boundary behavior) and
+    after it (the boundary band mixes valid values, so re-zeroing restores
+    a known pad value and makes inorm_3's sums unmasked-exact). That cuts
+    the r4 fast path's per-block mask traffic (two full-channel + two
+    half-channel passes plus a masked reduction) to two half-channel
+    passes. Statistics are identical up to float association
+    (padding-invariance tests are the oracle).
+
+    Fast path returns ``(out, pad_value_out)``; plain path returns the
+    masked tensor as before."""
 
     channels: int
     dilation: int
@@ -246,23 +249,29 @@ class BottleneckBlock(nn.Module):
     depad: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None, count=None):
+    def __call__(self, x, mask=None, count=None, pad_value=None):
         half = self.channels // 2
-        fast = (self.depad and self.use_inorm and mask is not None
-                and count is not None)
-        residual = x
+        fast = (self.depad and mask is not None and count is not None
+                and pad_value is not None)
+        residual, pv_res = x, pad_value
+        pv = pad_value
         if self.use_inorm:
-            # fast: block input is pre-masked (zero at pad) => unmasked sums.
-            x = InstanceNorm(self.channels, name="inorm_1")(
-                x, mask, count=count, depad=fast)
+            if fast:
+                x, pv = InstanceNorm(self.channels, name="inorm_1")(
+                    x, mask, count=count, pad_value=pv, depad=True)
+            else:
+                x = InstanceNorm(self.channels, name="inorm_1")(x, mask)
         x = nn.elu(x)
         if fast:
-            x, b1 = BiasConv1x1(half, dtype=self.dtype, name="conv2d_1")(x)
-            x = InstanceNorm(half, name="inorm_2")(
-                x, mask, count=count, pad_value=b1, depad=True)
-            x = nn.elu(x)
-            # inorm_2 zeroed the pad and elu(0) == 0: the 3x3 below already
-            # sees the zero boundary, no explicit re-mask needed.
+            pv = nn.elu(pv)
+            conv1 = nn.Conv(half, (1, 1), dtype=self.dtype, name="conv2d_1")
+            x, pv = conv1(x), conv1(pv)
+            if self.use_inorm:
+                x, pv = InstanceNorm(half, name="inorm_2")(
+                    x, mask, count=count, pad_value=pv, depad=True)
+            # Mask 1 of 2: the dilated 3x3 must see the reference's zero
+            # boundary, so the padded region is zeroed right before it.
+            x = nn.elu(x) * mask[..., None].astype(x.dtype)
         else:
             x = nn.Conv(half, (1, 1), dtype=self.dtype, name="conv2d_1")(x)
             if self.use_inorm:
@@ -280,20 +289,32 @@ class BottleneckBlock(nn.Module):
             half, (3, 3), kernel_dilation=(self.dilation, self.dilation),
             padding=self.dilation, dtype=self.dtype, name="conv2d_2",
         )(x)
+        if fast:
+            # Mask 2 of 2: the 3x3 mixed valid values into the boundary
+            # band of the pad, so the pad value is no longer uniform;
+            # re-zeroing restores pad_value == 0 and makes the following
+            # statistics unmasked-exact.
+            x = x * mask[..., None].astype(x.dtype)
+            pv = jnp.zeros_like(x[:, :1, :1, :])
+            if self.use_inorm:
+                x, pv = InstanceNorm(half, name="inorm_3")(
+                    x, mask, count=count, pad_value=pv, depad=True)
+            x = nn.elu(x)
+            pv = nn.elu(pv)
+            conv3 = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                            name="conv2d_3")
+            x, pv = conv3(x), conv3(pv)
+            x, pv = SEBlock(self.channels, dtype=self.dtype, name="se_block")(
+                x, mask, count=count, pad_value=pv)
+            return x + residual, pv + pv_res
         if self.use_inorm:
             # After the 3x3, boundary pad pixels mix valid values — the
-            # general masked reduction is required (both paths).
+            # general masked reduction is required.
             x = InstanceNorm(half, name="inorm_3")(x, mask)
         x = nn.elu(x)
-        if fast:
-            x, b3 = BiasConv1x1(self.channels, dtype=self.dtype,
-                                name="conv2d_3")(x)
-            x = SEBlock(self.channels, dtype=self.dtype, name="se_block")(
-                x, mask, count=count, pad_value=b3)
-        else:
-            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
-                        name="conv2d_3")(x)
-            x = SEBlock(self.channels, dtype=self.dtype, name="se_block")(x, mask)
+        x = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                    name="conv2d_3")(x)
+        x = SEBlock(self.channels, dtype=self.dtype, name="se_block")(x, mask)
         out = x + residual
         if mask is not None:
             out = out * mask[..., None].astype(out.dtype)
@@ -304,7 +325,8 @@ class DilationChunk(nn.Module):
     """One dilation cycle (the scan body when ``scan_chunks`` is on): the
     reference repeats this exact 4-block unit ``num_chunks`` times
     (deepinteract_modules.py:1060-1086). Returns the ``(carry, out)`` pair
-    ``nn.scan`` expects."""
+    ``nn.scan`` expects; in depad mode the carry is ``(x, pad_value)`` so
+    the tracked pad value survives across scan iterations."""
 
     channels: int
     dilation_cycle: Sequence[int]
@@ -314,16 +336,21 @@ class DilationChunk(nn.Module):
     depad: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None, count=None):
+    def __call__(self, carry, mask=None, count=None):
         # Block-granularity remat, matching the unrolled path's memory
         # behavior: each block stores only its input and recomputes inside.
         block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
+        if self.depad:
+            x, pv = carry
+        else:
+            x, pv = carry, None
         for d in self.dilation_cycle:
-            x = block_cls(
+            out = block_cls(
                 self.channels, d, self.use_inorm, self.dtype, self.depad,
                 name=f"block_d{d}",
-            )(x, mask, count)
-        return x, None
+            )(x, mask, count, pv)
+            x, pv = out if self.depad else (out, None)
+        return ((x, pv) if self.depad else x), None
 
 
 class DilatedResNet(nn.Module):
@@ -343,17 +370,22 @@ class DilatedResNet(nn.Module):
     depad: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None, count=None):
+    def __call__(self, x, mask=None, count=None, pad_value=None):
         # nn.remat preserves module naming, so remat and non-remat configs
-        # share one param/checkpoint tree.
-        depad = self.depad and self.use_inorm and mask is not None and count is not None
+        # share one param/checkpoint tree. Returns ``(x, pad_value_out)``
+        # in depad mode (pad-value tracking), else ``(x, None)``.
+        depad = (self.depad and mask is not None and count is not None
+                 and pad_value is not None)
         block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
+        pv = pad_value if depad else None
         if self.initial_projection:
-            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype, name="init_proj")(x)
+            proj = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                           name="init_proj")
+            x = proj(x)
             if depad:
-                # Establish the blocks' pre-masked-input invariant (the
-                # init_proj bias makes padded pixels nonzero).
-                x = x * mask[..., None].astype(x.dtype)
+                # Track the pad value through the projection (same params,
+                # [B, 1, 1, C] call) instead of re-masking the map.
+                pv = proj(pv)
         if self.scan_chunks and self.num_chunks > 1:
             # Compile ONE cycle, run it num_chunks times: params stack on a
             # leading [num_chunks] axis under 'chunks/'. ``in_axes=
@@ -365,24 +397,28 @@ class DilatedResNet(nn.Module):
                 length=self.num_chunks,
                 in_axes=(nn.broadcast, nn.broadcast),
             )
-            x, _ = scan(
+            carry = (x, pv) if depad else x
+            carry, _ = scan(
                 self.channels, tuple(self.dilation_cycle), self.use_inorm,
                 self.remat, self.dtype, depad, name="chunks",
-            )(x, mask, count)
+            )(carry, mask, count)
+            x, pv = carry if depad else (carry, None)
         else:
             for i in range(self.num_chunks):
                 for d in self.dilation_cycle:
-                    x = block_cls(
+                    out = block_cls(
                         self.channels, d, self.use_inorm, self.dtype, depad,
                         name=f"block_{i}_{d}",
-                    )(x, mask, count)
+                    )(x, mask, count, pv)
+                    x, pv = out if depad else (out, None)
         if self.extra_blocks:
             for i in range(2):
-                x = block_cls(
+                out = block_cls(
                     self.channels, 1, self.use_inorm, self.dtype, depad,
                     name=f"extra_block_{i}",
-                )(x, mask, count)
-        return x
+                )(x, mask, count, pv)
+                x, pv = out if depad else (out, None)
+        return x, pv
 
 
 class RegionalAttention(nn.Module):
@@ -455,36 +491,51 @@ class InteractionDecoder(nn.Module):
         pair_tensor = pair_tensor.astype(dt)
         # Valid-pixel count, computed ONCE and shared by every de-padded
         # statistic in the stack ([B, 1, 1, 1] float32).
-        count = None
-        if mask is not None and cfg.depad_stats:
+        depad = mask is not None and cfg.depad_stats
+        count = pv = None
+        if depad:
             count = jnp.maximum(
                 jnp.sum(mask.astype(jnp.float32), axis=(1, 2),
                         keepdims=True)[..., None], 1.0)
         x = nn.Conv(cfg.num_channels, (1, 1), dtype=dt, name="conv2d_1")(pair_tensor)
-        x = nn.elu(InstanceNorm(cfg.num_channels, name="inorm_1")(x, mask))
+        if depad:
+            # The ONE entry mask: the incoming pair tensor's padded pixels
+            # are arbitrary (GT features of padded nodes), so zero them
+            # once here — every later op tracks the pad value in closed
+            # form instead of re-masking (see BottleneckBlock).
+            x = x * mask[..., None].astype(x.dtype)
+            pv = jnp.zeros_like(x[:, :1, :1, :])
+            x, pv = InstanceNorm(cfg.num_channels, name="inorm_1")(
+                x, mask, count=count, pad_value=pv, depad=True)
+            x, pv = nn.elu(x), nn.elu(pv)
+        else:
+            x = nn.elu(InstanceNorm(cfg.num_channels, name="inorm_1")(x, mask))
 
-        x = nn.elu(
-            DilatedResNet(
-                cfg.num_channels, cfg.num_chunks, cfg.dilation_cycle,
-                use_inorm=True, initial_projection=True, remat=cfg.remat,
-                scan_chunks=cfg.scan_chunks, dtype=dt, depad=cfg.depad_stats,
-                name="base_resnet",
-            )(x, mask, count)
-        )
+        x, pv = DilatedResNet(
+            cfg.num_channels, cfg.num_chunks, cfg.dilation_cycle,
+            use_inorm=True, initial_projection=True, remat=cfg.remat,
+            scan_chunks=cfg.scan_chunks, dtype=dt, depad=cfg.depad_stats,
+            name="base_resnet",
+        )(x, mask, count, pv)
+        x = nn.elu(x)
+        pv = nn.elu(pv) if pv is not None else None
         if cfg.use_attention:
             x = nn.elu(RegionalAttention(
                 cfg.num_channels, num_heads=cfg.num_attention_heads,
                 region_size=cfg.region_size, dropout_rate=cfg.dropout_rate,
                 dtype=dt, name="mha2d_1",
             )(x, mask, train))
+            if pv is not None:
+                # RegionalAttention masks its output, so pads are zero again.
+                pv = jnp.zeros_like(pv)
 
-        x = nn.elu(
-            DilatedResNet(
-                cfg.num_channels, 1, cfg.dilation_cycle,
-                use_inorm=False, initial_projection=True, extra_blocks=True,
-                remat=cfg.remat, dtype=dt, name="phase2_resnet",
-            )(x, mask)
-        )
+        x, pv = DilatedResNet(
+            cfg.num_channels, 1, cfg.dilation_cycle,
+            use_inorm=False, initial_projection=True, extra_blocks=True,
+            remat=cfg.remat, dtype=dt, depad=cfg.depad_stats,
+            name="phase2_resnet",
+        )(x, mask, count, pv)
+        x = nn.elu(x)
         if cfg.use_attention:
             x = nn.elu(RegionalAttention(
                 cfg.num_channels, num_heads=cfg.num_attention_heads,

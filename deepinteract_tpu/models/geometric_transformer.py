@@ -68,8 +68,11 @@ class GTConfig:
     # measured divergence on realistic asymmetric kNN graphs.
     attention_mode: str = "scatter"  # 'scatter' (reference-exact) | 'gather' (TPU-fast)
     # 'auto': use the Pallas fused kernel (ops/pallas_attention.py) on TPU
-    # for scatter mode on buckets it supports, jnp elsewhere. 'jnp'/'pallas'
-    # force one path ('pallas' still falls back on unsupported buckets).
+    # for scatter-mode *inference* applies (train=False) on buckets it
+    # supports, jnp elsewhere — measured policy: the kernel wins the
+    # forward 1.18-1.70x but is neutral inside the decoder-bound train
+    # step (r4/r5 A/B, BASELINE.md). 'jnp'/'pallas' force one path
+    # ('pallas' still falls back on unsupported buckets).
     attention_impl: str = "auto"
 
 
@@ -225,9 +228,18 @@ class PlainEdgeModule(nn.Module):
         return GODense(self.cfg.hidden, use_bias=False, name="linear")(x)
 
 
-def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask):
+def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask,
+                        train: bool = False):
     """Pick the attention implementation: Pallas fused kernel on TPU for
-    reference-exact scatter mode on supported buckets, jnp otherwise."""
+    reference-exact scatter mode on supported buckets, jnp otherwise.
+
+    ``auto`` routing is evidence-driven (VERDICT r4 item 7): the fused
+    kernel is measured 1.18-1.70x faster on the inference forward at p128
+    but neutral (0.95-1.06x) inside the train step, where attention is
+    <=9% of FLOPs and the step is decoder-bound — so auto uses Pallas only
+    for ``train=False`` applies (forward/eval/predict) and the jnp scatter
+    path for training. Force with attention_impl='pallas'/'jnp' (the
+    bench's A/B does exactly that)."""
     n = q.shape[1]
     use_pallas = False
     if cfg.attention_mode == "scatter" and cfg.attention_impl in ("auto", "pallas"):
@@ -236,10 +248,10 @@ def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask):
         if supports(n):
             if cfg.attention_impl == "pallas":
                 use_pallas = True
-            else:  # auto: only where the Mosaic TPU backend is present
+            else:  # auto: inference only, and only on the Mosaic TPU backend
                 import jax
 
-                use_pallas = jax.default_backend() == "tpu"
+                use_pallas = (not train) and jax.default_backend() == "tpu"
     if use_pallas:
         import jax
 
@@ -259,7 +271,8 @@ class MultiHeadGeometricAttention(nn.Module):
     update_edge_feats: bool = True
 
     @nn.compact
-    def __call__(self, graph: ProteinGraph, node_feats, edge_feats):
+    def __call__(self, graph: ProteinGraph, node_feats, edge_feats,
+                 train: bool = False):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.hidden // cfg.num_heads
         b, n, k = graph.nbr_idx.shape
@@ -273,7 +286,7 @@ class MultiHeadGeometricAttention(nn.Module):
         ).reshape(b, n, k, h, d)
 
         h_out, e_out = _dispatch_attention(
-            cfg, q, kk, v, proj_e, graph.nbr_idx, graph.edge_mask()
+            cfg, q, kk, v, proj_e, graph.nbr_idx, graph.edge_mask(), train
         )
         h_out = h_out.reshape(b, n, cfg.hidden)
         e_out = e_out.reshape(b, n, k, cfg.hidden) if self.update_edge_feats else None
@@ -307,7 +320,7 @@ class GeometricTransformerLayer(nn.Module):
 
         node_attn, edge_attn = MultiHeadGeometricAttention(
             cfg, update_edge_feats=self.update_edge_feats, name="mha"
-        )(graph, node_feats, edge_feats)
+        )(graph, node_feats, edge_feats, train)
 
         drop = nn.Dropout(cfg.dropout_rate, deterministic=not train)
         node_feats = GODense(cfg.hidden, name="O_node")(drop(node_attn))

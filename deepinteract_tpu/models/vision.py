@@ -187,7 +187,16 @@ class BottleneckResBlock(nn.Module):
         # Stride on the first 1x1 (ResNet v1 convention): the downsampled
         # mask the encoder passes then matches every norm in the block
         # (stride on the 3x3, v1.5, would hand the first norm a mask at
-        # the wrong scale).
+        # the wrong scale). CHECKPOINT-IMPORT CAVEAT (ADVICE r4 item 2):
+        # torchvision/timm resnet50 — what the reference's
+        # TimmUniversalEncoder loads — is v1.5 (stride on the 3x3). Param
+        # shapes are IDENTICAL, so v1.5 weights would load shape-clean here
+        # yet compute different activations at every strided bottleneck.
+        # The torch importer maps only the dilated-decoder checkpoint
+        # family (training/import_torch.py) — it has NO DeepLab-encoder
+        # mapping, so a v1.5 import cannot happen silently; anyone adding
+        # one must re-layout the stride onto the 3x3 (and rescale the
+        # masks) first. Our from-scratch resnet50 trains under v1.
         y = ConvNormAct(mid, 1, self.stride)(x, mask)
         y = ConvNormAct(mid, 3, 1, self.dilation)(y, mask)
         y = ConvNormAct(self.features, 1, use_act=False)(y, mask)

@@ -294,18 +294,33 @@ class Trainer:
             from jax.experimental import multihost_utils
 
             cm = np.asarray(host_batch.contact_map)
+            # Include the host's total val-batch count when the source
+            # exposes it (ADVICE r4 item 3): hosts with identical first
+            # batches but different loader LENGTHS would otherwise pass
+            # this assert and then deadlock silently — the short host
+            # exits the loop while the others block in a collective.
+            # BucketedLoader sizes itself via num_batches(); plain sized
+            # iterables via len(); unsized callables fall back to the
+            # first-batch check only.
+            sizer = getattr(val_data, "num_batches", None)
+            try:
+                n_batches = float(sizer() if callable(sizer)
+                                  else len(val_data))  # type: ignore[arg-type]
+            except TypeError:
+                n_batches = -1.0  # unsized source; first-batch check only
             fingerprint = np.asarray(
                 [float(np.asarray(host_batch.graph1.num_nodes).sum()),
                  float(np.asarray(host_batch.graph2.num_nodes).sum()),
                  float(cm.shape[0]), float(cm.shape[1]), float(cm.shape[2]),
-                 float(cm.sum())],
+                 float(cm.sum()), n_batches],
                 dtype=np.float32,
             )
             multihost_utils.assert_equal(
                 fingerprint,
                 fail_message=(
-                    "evaluate: hosts fed different first val batches — the "
-                    "val loader must be identical (unsharded) on every host"
+                    "evaluate: hosts fed different first val batches or "
+                    "val-loader lengths — the val loader must be identical "
+                    "(unsharded) on every host"
                 ),
             )
 
@@ -503,8 +518,35 @@ class Trainer:
                     f"grad_norm={float(host_local_array(metrics['grad_norm'])):.4f}"
                 )
 
+        # Double-buffered metric fetch (VERDICT r4 item 3): the host fetch
+        # of a dispatch's stacked metrics blocks until the device finishes,
+        # so fetching IMMEDIATELY after dispatch serializes host work
+        # (loading + stacking the next run) behind device compute. Instead
+        # the fetch of dispatch N is deferred until dispatch N+1 has been
+        # submitted — jit dispatch is async, so stacking run N+1 then
+        # overlaps the device executing run N, and by the time N's metrics
+        # are read they are already resident.
+        pending = None  # (stacked device metrics, run length)
+
+        def flush(entry):
+            stacked, n = entry
+            # ONE host fetch per metric leaf per dispatch: per-step
+            # slicing of the device array (m[j] then float()) costs a
+            # device round trip PER MICROBATCH, which at K=8 through a
+            # remote-device tunnel dominates the logging path
+            # (measured, tools/sustained_train.py r4).
+            stacked_host = {
+                k: np.asarray(host_local_array(v))
+                for k, v in stacked.items()
+            }
+            for j in range(n):
+                log_step({k: v[j] for k, v in stacked_host.items()})
+
         for run in _shape_runs(_iter_data(train_data, epoch), k):
             if len(run) < max(k, 2):
+                if pending is not None:
+                    flush(pending)
+                    pending = None
                 for b in run:
                     state, metrics = self._train_step(state, self._device_batch(b))
                     log_step(metrics)
@@ -516,17 +558,11 @@ class Trainer:
                 # construction in _device_stacked.
                 state, stacked = self._multi_step(
                     state, self._device_stacked(stack_microbatches(run)))
-                # ONE host fetch per metric leaf per dispatch: per-step
-                # slicing of the device array (m[j] then float()) costs a
-                # device round trip PER MICROBATCH, which at K=8 through a
-                # remote-device tunnel dominates the logging path
-                # (measured, tools/sustained_train.py r4).
-                stacked_host = {
-                    k: np.asarray(host_local_array(v))
-                    for k, v in stacked.items()
-                }
-                for j in range(len(run)):
-                    log_step({k: v[j] for k, v in stacked_host.items()})
+                if pending is not None:
+                    flush(pending)  # N-1's fetch, after N's async dispatch
+                pending = (stacked, len(run))
+        if pending is not None:
+            flush(pending)
         return state
 
     def _device_batch(self, batch: PairedComplex) -> PairedComplex:

@@ -186,6 +186,54 @@ def test_bucketed_loader_shapes_and_shuffle(rng):
     assert all(b.graph1.node_feats.shape[0] == 2 for b in strict.iter_epoch(0))
 
 
+def test_diagonal_buckets(rng):
+    """diagonal_buckets pads both chains to the larger chain's bucket, so
+    only (b, b) shape pairs occur (compile-tax lever, VERDICT r4 item 6)."""
+    raws = [make_raw_complex(n1, n2, rng)
+            for n1, n2 in [(20, 16), (30, 40), (70, 20), (20, 18)]]
+    ds = InMemoryDataset(raws)
+    loader = BucketedLoader(ds, batch_size=1, diagonal_buckets=True)
+    shapes = {(b.graph1.node_feats.shape[1], b.graph2.node_feats.shape[1])
+              for b in loader.iter_epoch(0)}
+    assert shapes == {(64, 64), (128, 128)}  # (70, 20) forced diagonal
+    total = sum(b.graph1.node_feats.shape[0] for b in loader.iter_epoch(0))
+    assert total == 4
+
+
+def test_packed_dataset_matches_unpacked(tmp_path, rng):
+    """Pack + mmap batch assembly must reproduce the unpacked loader's
+    batches bit-for-bit (same plan seed), including targets order."""
+    from deepinteract_tpu.data.loader import make_bucket_fn
+    from deepinteract_tpu.data.packed import PackedDataset, pack_dataset
+
+    raws = [make_raw_complex(n1, n2, rng)
+            for n1, n2 in [(20, 16), (30, 40), (70, 20), (20, 18), (25, 33)]]
+    ds = InMemoryDataset(raws)
+    pack_dir = pack_dataset(ds, str(tmp_path / "pack"), make_bucket_fn())
+    packed = PackedDataset(pack_dir)
+    assert len(packed) == len(ds)
+    assert packed.lengths() == ds.lengths()
+
+    kw = dict(batch_size=2, shuffle=True, seed=7, prefetch=0)
+    ref_loader = BucketedLoader(ds, **kw)
+    packed_loader = BucketedLoader(packed, **kw)
+    ref = list(ref_loader.iter_epoch(1, with_targets=True))
+    got = list(packed_loader.iter_epoch(1, with_targets=True))
+    assert len(ref) == len(got)
+    for (rb, rt), (gb, gt) in zip(ref, got):
+        assert rt == gt
+        import jax
+
+        for a, b in zip(jax.tree_util.tree_leaves(rb),
+                        jax.tree_util.tree_leaves(gb)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Idempotent re-pack (index present, same item count) is a no-op.
+    assert pack_dataset(ds, pack_dir, make_bucket_fn()) == pack_dir
+    # Requesting a mismatched bucket fails loudly.
+    with pytest.raises(ValueError):
+        packed.padded_batch([0], (9999, 9999))
+
+
 def test_bucketed_loader_multihost_shard(rng):
     """Coordinated multi-host sharding: every host plans the same global
     batches and loads a disjoint batch_size-slice of each, so step counts

@@ -118,7 +118,8 @@ def main() -> int:
                 # not expressible via config; emulate by zero-size? Instead
                 # time the resnet as-is minus inorm separately; see no-se2.
                 pass
-            h = nn.elu(resnet(h, m))
+            h, _ = resnet(h, m)
+            h = nn.elu(h)
             return nn.Conv(2, (1, 1), name="head")(h)
 
     run("no-inorm", StrippedDecoder(use_inorm=False))

@@ -22,10 +22,21 @@ multi-complex dataset with ``cli.build_dataset`` (real split files), and
 run ``cli.train`` -> ``cli.test`` -> per-target CSV end-to-end on data the
 builder produced from disk.
 
+Stage C — **held-out generalization protocol** (VERDICT r4 item 4): a
+larger fragment-complex corpus (cartesian window pairs over both chains),
+partitioned at the COMPLEX level so every test complex appears in no
+training or validation batch; train on the train split with early
+stopping on val, report the reference top-k metric table on the held-out
+complexes. Honesty caveat, stated wherever the numbers are: held-out
+complexes are unseen (row, col) window pairs of the same underlying 4heq
+structure — unseen complexes, not an unseen protein family; that is the
+strongest generalization evidence constructible offline from the one real
+complex the reference ships.
+
 Usage (defaults reproduce the BASELINE.md numbers)::
 
     python tools/real_data_proof.py --work_dir /tmp/realproof \
-        [--epochs_a 25] [--epochs_b 12] [--tiny]
+        [--epochs_a 25] [--epochs_b 12] [--epochs_c 30] [--tiny]
 """
 
 from __future__ import annotations
@@ -83,6 +94,61 @@ def derive_fragment_pairs(work_dir: str, window: int = 100):
         kept.append((name, int(sub.sum())))
     print(f"fragments kept: {kept} (full pair: {int(labels.sum())} contacts)")
     return input_dir
+
+
+def derive_cartesian_fragments(work_dir: str, window: int = 100,
+                               stride: int = 15, min_contacts: int = 5):
+    """Stage C corpus: ALL (row-window, col-window) pairs with at least
+    ``min_contacts`` interface contacts, written as real PDB pairs.
+
+    Unlike :func:`derive_fragment_pairs` (diagonal zip, few complexes),
+    the cartesian product yields enough distinct complexes to hold some
+    out entirely."""
+    from deepinteract_tpu.pipeline.pair import interface_labels, load_structure
+    from deepinteract_tpu.pipeline.pdb import write_pdb
+
+    left = load_structure(os.path.join(REF_TEST_DATA, "4heq_l_u.pdb"))
+    right = load_structure(os.path.join(REF_TEST_DATA, "4heq_r_u.pdb"))
+    labels = interface_labels(left, right)
+
+    input_dir = os.path.join(work_dir, "input_pdbs_c")
+    os.makedirs(input_dir, exist_ok=True)
+    n1, n2 = len(left), len(right)
+    window = min(window, n1, n2)
+    starts1 = sorted(set(range(0, n1 - window + 1, stride)) | {n1 - window})
+    starts2 = sorted(set(range(0, n2 - window + 1, stride)) | {n2 - window})
+    kept = []
+    for s1 in starts1:
+        for s2 in starts2:
+            sub = labels[s1 : s1 + window, s2 : s2 + window]
+            if int(sub.sum()) < min_contacts:
+                continue
+            name = f"4heq_w{s1:03d}_{s2:03d}"
+            write_pdb(left.slice_residues(s1, s1 + window),
+                      os.path.join(input_dir, f"{name}_l_u.pdb"))
+            write_pdb(right.slice_residues(s2, s2 + window),
+                      os.path.join(input_dir, f"{name}_r_u.pdb"))
+            kept.append((name, int(sub.sum())))
+    print(f"stage C fragments kept: {len(kept)} "
+          f"({[k for k, _ in kept]})")
+    if len(kept) < 6:
+        raise SystemExit(
+            "stage C needs >= 6 fragment complexes for a held-out split; "
+            "lower --min_contacts or the stride")
+    return input_dir, [k for k, _ in kept]
+
+
+def heldout_split(names):
+    """Complex-level partition: every 4th complex (by sorted name) is held
+    out for test; of the rest, every 5th is val, remainder train. The
+    test complexes appear in no training or validation batch — the
+    disjointness STAGE C exists to prove (asserted by the caller)."""
+    names = sorted(names)
+    test = names[::4]
+    rest = [n for n in names if n not in test]
+    val = rest[::5]
+    train = [n for n in rest if n not in val]
+    return train, val, test
 
 
 def build_dataset(input_dir: str, out_dir: str) -> None:
@@ -147,8 +213,10 @@ def main(argv=None) -> int:
                         "epoch (8 steps/epoch -> one scanned dispatch)")
     p.add_argument("--tiny", action="store_true",
                    help="tiny model (CI-scale smoke, not the proof run)")
+    p.add_argument("--epochs_c", type=int, default=30)
     p.add_argument("--skip_a", action="store_true")
     p.add_argument("--skip_b", action="store_true")
+    p.add_argument("--skip_c", action="store_true")
     args = p.parse_args(argv)
 
     if not os.path.isdir(REF_TEST_DATA):
@@ -194,6 +262,30 @@ def main(argv=None) -> int:
         results["stage_b_builder_end_to_end"] = m
         assert os.path.exists(csv_b)
         print(f"stage B done in {m['wall_seconds']:.0f}s; CSV at {csv_b}")
+
+    if not args.skip_c:
+        t0 = time.time()
+        input_dir_c, names = derive_cartesian_fragments(args.work_dir)
+        root_c = os.path.join(args.work_dir, "dataset_c")
+        build_dataset(input_dir_c, root_c)
+        train, val, test = heldout_split(names)
+        print(f"stage C split: {len(train)} train / {len(val)} val / "
+              f"{len(test)} HELD-OUT test: {test}")
+        assert not (set(test) & set(train)) and not (set(test) & set(val))
+        overwrite_splits(root_c, [f"{n}.npz" for n in train],
+                         [f"{n}.npz" for n in val],
+                         [f"{n}.npz" for n in test])
+        ckpt_c = os.path.join(args.work_dir, "ckpt_c")
+        shutil.rmtree(ckpt_c, ignore_errors=True)
+        run_train(root_c, ckpt_c, args.epochs_c, model_flags)
+        csv_c = os.path.join(args.work_dir, "stage_c_top_metrics.csv")
+        m = run_test(root_c, ckpt_c, csv_c, model_flags)
+        m["wall_seconds"] = time.time() - t0
+        m["n_train"], m["n_val"], m["n_heldout"] = (
+            len(train), len(val), len(test))
+        results["stage_c_heldout_generalization"] = m
+        print(f"stage C done in {m['wall_seconds']:.0f}s; held-out "
+              f"metrics above; CSV at {csv_c}")
 
     print(json.dumps(results, indent=2, sort_keys=True))
     with open(os.path.join(args.work_dir, "results.json"), "w") as fh:
