@@ -90,11 +90,16 @@ def main(argv=None) -> int:
         bucket_fn = make_bucket_fn(args.pad_to_max_bucket,
                                    args.diagonal_buckets)
         eval_bucket_fn = make_bucket_fn(False, False)
+        # The signature must encode EVERY flag that changes pack content:
+        # bucket-fn flags (bucket layout) and input_indep (the stored
+        # features themselves are zeroed under the ablation).
         train_sig = (f"pad_max={args.pad_to_max_bucket},"
-                     f"diag={args.diagonal_buckets}")
+                     f"diag={args.diagonal_buckets},"
+                     f"indep={args.input_indep}")
+        eval_sig = f"eval,indep={args.input_indep}"
         specs = (("train", train_ds, bucket_fn, train_sig),
-                 ("val", val_ds, eval_bucket_fn, "eval"),
-                 ("test", test_ds, eval_bucket_fn, "eval"))
+                 ("val", val_ds, eval_bucket_fn, eval_sig),
+                 ("test", test_ds, eval_bucket_fn, eval_sig))
         # Multi-host: only process 0 writes the pack (concurrent writers
         # on shared storage would corrupt it); everyone else waits at the
         # barrier and then reads it.

@@ -175,6 +175,40 @@ class InstanceNorm(nn.Module):
         return masked_instance_norm(x, mask, scale, bias)
 
 
+class PVConv1x1(nn.Module):
+    """1x1 conv that also maps the tracked pad value through the same
+    parameters.
+
+    Param tree is identical to ``nn.Conv(features, (1, 1))`` (kernel
+    [1, 1, I, O] lecun-normal, bias [O] zeros) — checkpoints are
+    interchangeable. The map goes through the real conv; the [B, 1, 1, C]
+    pad value goes through a broadcast-multiply + sum formulation of the
+    same affine, which XLA fuses into a tiny reduce instead of paying a
+    full conv/dot kernel launch (~24 us each on a v5e — 112 of them per
+    decoder forward measurably dominated the depad path's overhead,
+    tools/tiny_op_probe.py)."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pv=None):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (1, 1, x.shape[-1], self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        k = kernel.astype(self.dtype)
+        b = bias.astype(self.dtype)
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), k, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+        if pv is None:
+            return y, None
+        pv_out = jnp.sum(pv.astype(self.dtype)[..., :, None] * k[0, 0],
+                         axis=-2) + b
+        return y, pv_out
+
+
 class SEBlock(nn.Module):
     """Squeeze-and-excitation over the (masked) spatial mean
     (deepinteract_modules.py:954-970).
@@ -264,8 +298,7 @@ class BottleneckBlock(nn.Module):
         x = nn.elu(x)
         if fast:
             pv = nn.elu(pv)
-            conv1 = nn.Conv(half, (1, 1), dtype=self.dtype, name="conv2d_1")
-            x, pv = conv1(x), conv1(pv)
+            x, pv = PVConv1x1(half, dtype=self.dtype, name="conv2d_1")(x, pv)
             if self.use_inorm:
                 x, pv = InstanceNorm(half, name="inorm_2")(
                     x, mask, count=count, pad_value=pv, depad=True)
@@ -301,9 +334,8 @@ class BottleneckBlock(nn.Module):
                     x, mask, count=count, pad_value=pv, depad=True)
             x = nn.elu(x)
             pv = nn.elu(pv)
-            conv3 = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
-                            name="conv2d_3")
-            x, pv = conv3(x), conv3(pv)
+            x, pv = PVConv1x1(self.channels, dtype=self.dtype,
+                              name="conv2d_3")(x, pv)
             x, pv = SEBlock(self.channels, dtype=self.dtype, name="se_block")(
                 x, mask, count=count, pad_value=pv)
             return x + residual, pv + pv_res
@@ -379,13 +411,12 @@ class DilatedResNet(nn.Module):
         block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
         pv = pad_value if depad else None
         if self.initial_projection:
-            proj = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
-                           name="init_proj")
-            x = proj(x)
+            # Tracks the pad value through the projection in fused
+            # broadcast-sum form instead of re-masking the map.
+            x, pv_out = PVConv1x1(self.channels, dtype=self.dtype,
+                                  name="init_proj")(x, pv)
             if depad:
-                # Track the pad value through the projection (same params,
-                # [B, 1, 1, C] call) instead of re-masking the map.
-                pv = proj(pv)
+                pv = pv_out
         if self.scan_chunks and self.num_chunks > 1:
             # Compile ONE cycle, run it num_chunks times: params stack on a
             # leading [num_chunks] axis under 'chunks/'. ``in_axes=

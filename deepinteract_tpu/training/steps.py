@@ -40,7 +40,12 @@ def create_train_state(
     param subtrees (fine-tune mode, deepinteract_modules.py:1546-1557)."""
     root = jax.random.PRNGKey(seed)
     params_rng, dropout_rng = jax.random.split(root)
-    variables = model.init(
+    # jit the init: eager flax init dispatches thousands of individual ops,
+    # which through a remote-device tunnel (~tens of ms per dispatch) costs
+    # minutes; one compiled executable costs one compile (measured, r5
+    # bench rehearsal). Shape-identical re-inits also hit the jit cache.
+    init_fn = jax.jit(model.init, static_argnames=("train",))
+    variables = init_fn(
         {"params": params_rng, "dropout": dropout_rng},
         example.graph1,
         example.graph2,

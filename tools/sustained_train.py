@@ -96,6 +96,12 @@ def main() -> int:
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--out", default="/tmp/sustained_train.json")
     ap.add_argument("--ckpt_dir", default="/tmp/sustained_ckpt")
+    ap.add_argument("--diagonal_buckets", action="store_true",
+                    help="forward cli.train's --diagonal_buckets (2 "
+                         "shape-pair compiles on this corpus instead of 4)")
+    ap.add_argument("--packed_cache_dir", default=None,
+                    help="forward cli.train's --packed_cache_dir (mmap "
+                         "batch assembly; pack built on first run)")
     args = ap.parse_args()
 
     marker = os.path.join(args.root, "pairs-postprocessed-train.txt")
@@ -132,8 +138,7 @@ def main() -> int:
 
     from deepinteract_tpu.cli import train as train_cli
 
-    t_start = time.perf_counter()
-    rc = train_cli.main([
+    cli_args = [
         "--dips_root", args.root,
         "--num_epochs", str(args.epochs),
         "--ckpt_dir", args.ckpt_dir,
@@ -142,7 +147,13 @@ def main() -> int:
         # 256-bucket complexes need decoder remat on a 16G chip (the
         # scanned decoder's backward residuals OOM without it).
         "--remat",
-    ])
+    ]
+    if args.diagonal_buckets:
+        cli_args.append("--diagonal_buckets")
+    if args.packed_cache_dir:
+        cli_args += ["--packed_cache_dir", args.packed_cache_dir]
+    t_start = time.perf_counter()
+    rc = train_cli.main(cli_args)
     wall = time.perf_counter() - t_start
     assert rc == 0
 

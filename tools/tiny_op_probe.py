@@ -1,0 +1,96 @@
+"""Launch-cost probe: 112 tiny pad-value transforms as conv vs dot.
+
+The r5 pad-value-tracking decoder applies each 1x1 conv to BOTH the
+[B, H, W, C] map and the [B, 1, 1, C] tracked pad value. The map conv is
+MXU work; the pad-value transform is ~8k MACs but, expressed as
+``lax.conv_general_dilated``, costs a full conv-kernel launch. 112 of
+them per forward (2 per block x 56 blocks) could explain a chunk of the
+full-vs-no-mask decoder gap (tools/decoder_ablation.py). This probe
+times 112 chained tiny transforms under one jit, expressed three ways.
+
+Usage: python tools/tiny_op_probe.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+K = 64  # scan length for differenced timing
+N_OPS = 112
+
+
+def timed(fn, *args):
+    import jax
+
+    jfn = jax.jit(fn)
+    compiled = jfn.lower(*args).compile()
+    out = compiled(*args)
+    float(np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0])).ravel()[0])
+
+    def run(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = compiled(*args)
+        jax.block_until_ready(out)
+        float(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(out)[0])).ravel()[0])
+        return time.perf_counter() - t0
+
+    samples = []
+    for _ in range(3):
+        t1, t2 = run(2), run(4)
+        samples.append((t2 - t1) / 2)
+    return float(np.median(samples))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    print(f"device={jax.devices()[0].device_kind} ops={N_OPS}", flush=True)
+    rng = np.random.default_rng(0)
+    pv = jnp.asarray(rng.standard_normal((1, 1, 1, 64)).astype(np.float32))
+    kernel = jnp.asarray(rng.standard_normal((1, 1, 64, 64)).astype(np.float32) * 0.1)
+    bias = jnp.asarray(rng.standard_normal((64,)).astype(np.float32))
+
+    def chain_conv(pv, kernel, bias):
+        x = pv
+        for _ in range(N_OPS):
+            x = jax.lax.conv_general_dilated(
+                x, kernel, (1, 1), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
+            x = jnp.tanh(x) * 1e-3  # keep magnitudes bounded
+        return jnp.sum(x)
+
+    def chain_dot(pv, kernel, bias):
+        x = pv[:, 0, 0, :]
+        k2 = kernel[0, 0]
+        for _ in range(N_OPS):
+            x = x @ k2 + bias
+            x = jnp.tanh(x) * 1e-3
+        return jnp.sum(x)
+
+    def chain_elementwise(pv, kernel, bias):
+        x = pv[:, 0, 0, :]
+        for _ in range(N_OPS):
+            x = x * bias + bias
+            x = jnp.tanh(x) * 1e-3
+        return jnp.sum(x)
+
+    for name, fn in (("conv1x1", chain_conv), ("dot", chain_dot),
+                     ("elementwise", chain_elementwise)):
+        t = timed(fn, pv, kernel, bias)
+        print(f"{name:12s} {t*1e3:8.3f} ms for {N_OPS} ops "
+              f"({t/N_OPS*1e6:.1f} us/op)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
