@@ -85,6 +85,7 @@ _T0 = time.monotonic()
 # init and device-resident arg reuse; the skip rule adds slack.
 SECTION_EST_S = {
     "b1_p128": 440,
+    "b8_p128_bf16": 300,
     "b8_p128_remat": 280,
     "b1_p256": 300,
     "eval_path": 220,
@@ -504,7 +505,9 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
     return entry
 
 
-# Shape table: label -> (batch, n1, n2, pad, remat, mode). b1_p128 is the
+# Shape table: label -> (batch, n1, n2, pad, remat, mode[, dtype]) — the
+# optional 7th element overrides the global DI_BENCH_DTYPE for that
+# bucket (see b8_p128_bf16). b1_p128 is the
 # headline (mode 'full'); b1_p256 is the reference training regime
 # (RESIDUE_COUNT_LIMIT = 256, deepinteract_constants.py:10-12); b8/b16
 # +remat are the large-batch configs (lean: the scanned figure is the
@@ -513,6 +516,13 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
 BUCKET_SHAPES = {
     "b1_p128": (1, 100, 80, 128, False, "full"),
     "b8_p128_remat": (8, 100, 80, 128, True, "lean"),
+    # The throughput/MFU flagship config: bf16 decoder activations at
+    # batch 8. The r5 pad-value-tracking decoder removed the float32
+    # masking islands that used to neutralize bf16, and the combo now
+    # measures 1.58x over f32 at b8 (150 ms/step scanned, 53 c/s,
+    # analytic scan MFU ~0.13 — tools/scan_ab.py). Overrides the global
+    # DI_BENCH_DTYPE for this bucket only.
+    "b8_p128_bf16": (8, 100, 80, 128, True, "lean", "bfloat16"),
     # p256 runs with decoder remat: the scanned decoder's backward stores
     # per-iteration scan residuals, which at 256x256 maps exceed a 16G
     # v5e's HBM without rematerialization (measured: OOM at AllocateBuffer
@@ -560,13 +570,14 @@ def _setup():
             f"DI_BENCH_DTYPE must be 'float32' or 'bfloat16', got {bench_dtype!r}"
         )
 
-    def make_model(remat=False, attention_impl="auto"):
+    def make_model(remat=False, attention_impl="auto", dtype=None):
         base = ModelConfig()
         return DeepInteract(dataclasses.replace(
             base,
             gnn=dataclasses.replace(base.gnn, attention_impl=attention_impl),
             decoder=dataclasses.replace(
-                base.decoder, compute_dtype=bench_dtype, remat=remat),
+                base.decoder, compute_dtype=dtype or bench_dtype,
+                remat=remat),
         ))
 
     def make_extra(**overrides):
@@ -605,8 +616,13 @@ def _section_names(platform: str) -> list:
     (DI_BENCH_SECTION=ab_p256): the default A/B rides inside b1_p128."""
     if os.environ.get("DI_BENCH_FAST"):
         return ["b1_p128"]
-    names = ["b1_p128", "b8_p128_remat", "b1_p256", "b1_p384_tiled_fwd",
-             "eval_path", "b16_p128_remat"]
+    # b16_p128_remat is NOT in the default list: the measured scaling is
+    # NEGATIVE (620 ms/step scanned = 25.8 c/s vs b8's 33.6, tools/
+    # scan_ab.py r5 — the chip saturates at b8), so the budget it would
+    # consume is better spent on eval_path. Run it manually via
+    # DI_BENCH_SECTION=b16_p128_remat.
+    names = ["b1_p128", "b8_p128_bf16", "b8_p128_remat", "b1_p256",
+             "b1_p384_tiled_fwd", "eval_path"]
     if os.environ.get("DI_BENCH_EXTRA"):
         names += [n for n in EXTRA_SHAPES if n not in names]
     return names
@@ -619,8 +635,10 @@ def _run_bucket_section(label: str, ctx, detail) -> None:
     from deepinteract_tpu.training.steps import create_train_state
 
     if label in BUCKET_SHAPES:
-        bs, n1, n2, pad, remat, mode = BUCKET_SHAPES[label]
-        bench_model = ctx["make_model"](remat=remat)
+        spec = BUCKET_SHAPES[label]
+        bs, n1, n2, pad, remat, mode = spec[:6]
+        bucket_dtype = spec[6] if len(spec) > 6 else None
+        bench_model = ctx["make_model"](remat=remat, dtype=bucket_dtype)
         extra = False
     else:
         bs, n1, n2, pad, remat, mode = EXTRA_SHAPES[label]
@@ -673,21 +691,23 @@ def _child_time_left() -> float:
 
 def _run_inline_ab(bucket_entry, state, batch, ctx, detail) -> None:
     """Pallas-vs-jnp A/B folded into the headline section (VERDICT r4
-    item 1): the bucket's own 'auto' measurements already cover one side
-    of each comparison (auto = Pallas for the inference forward, jnp for
-    the train step — see GTConfig.attention_impl), so only the two
-    complementary forced executables compile here. The bucket's train
-    state is reused via ``state.replace(apply_fn=...)`` — the forced
-    models share its exact param tree, and a fresh ``create_train_state``
-    would pay another init compile through the tunnel. Halves skip with
-    a recorded reason when the parent's section deadline is too close
-    (the r5 rehearsal lost the A/B to the section timeout)."""
+    item 1): the bucket's own 'auto' measurements ARE the Pallas side
+    (auto = Pallas wherever supported — see GTConfig.attention_impl), so
+    only the jnp-forced forward + train step compile here. The bucket's
+    train state is reused via ``state.replace(apply_fn=...)`` — the
+    forced model shares its exact param tree, and a fresh
+    ``create_train_state`` would pay another init compile through the
+    tunnel. Halves skip with a recorded reason when the parent's section
+    deadline is too close (the r5 rehearsal lost the A/B to the section
+    timeout)."""
     import jax
 
     from deepinteract_tpu.training.steps import train_step
 
-    ab = {"note": ("auto-side numbers reused from the b1_p128 bucket "
-                   "(auto = pallas forward / jnp train)")}
+    ab = {"note": ("pallas-side numbers reused from the b1_p128 bucket "
+                   "(auto = pallas); jnp side forced"),
+          "pallas": {"forward_ms": bucket_entry.get("forward_ms"),
+                     "train_ms": bucket_entry.get("train_ms")}}
     try:
         m_jnp = ctx["make_model"](attention_impl="jnp")
         if _child_time_left() < 120:
@@ -701,21 +721,17 @@ def _run_inline_ab(bucket_entry, state, batch, ctx, detail) -> None:
             )
             _, ft, _ = _time_compiled(
                 fwd, (state.params, state.batch_stats, batch))
-            ab["jnp"] = {"forward_ms": ft["median"] * 1e3,
-                         "train_ms": bucket_entry.get("train_ms")}
+            ab["jnp"] = {"forward_ms": ft["median"] * 1e3}
         detail["attention_ab_b1_p128"] = ab
         _dump_partial(detail)
 
         if _child_time_left() < 180:
-            ab["pallas"] = {"forward_ms": bucket_entry.get("forward_ms"),
-                            "skipped": "section deadline too close"}
+            ab["jnp"].setdefault("skipped", "section deadline too close")
         else:
-            m_pl = ctx["make_model"](attention_impl="pallas")
-            s_pl = state.replace(apply_fn=m_pl.apply)
+            s_jnp = state.replace(apply_fn=m_jnp.apply)
             tstep = jax.jit(lambda s, b: train_step(s, b))
-            _, tt, _ = _time_compiled(tstep, (s_pl, batch))
-            ab["pallas"] = {"forward_ms": bucket_entry.get("forward_ms"),
-                            "train_ms": tt["median"] * 1e3}
+            _, tt, _ = _time_compiled(tstep, (s_jnp, batch))
+            ab["jnp"]["train_ms"] = tt["median"] * 1e3
         if ab["jnp"].get("forward_ms") and ab["pallas"].get("forward_ms"):
             ab["pallas_speedup_forward"] = (
                 ab["jnp"]["forward_ms"] / ab["pallas"]["forward_ms"])

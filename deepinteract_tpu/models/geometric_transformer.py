@@ -68,11 +68,11 @@ class GTConfig:
     # measured divergence on realistic asymmetric kNN graphs.
     attention_mode: str = "scatter"  # 'scatter' (reference-exact) | 'gather' (TPU-fast)
     # 'auto': use the Pallas fused kernel (ops/pallas_attention.py) on TPU
-    # for scatter-mode *inference* applies (train=False) on buckets it
-    # supports, jnp elsewhere — measured policy: the kernel wins the
-    # forward 1.18-1.70x but is neutral inside the decoder-bound train
-    # step (r4/r5 A/B, BASELINE.md). 'jnp'/'pallas' force one path
-    # ('pallas' still falls back on unsupported buckets).
+    # for scatter mode wherever the kernel supports the (batch, bucket)
+    # shape, jnp elsewhere — measured policy: the kernel wins the forward
+    # 1.18-2.06x and the scanned train step 1.02x (f32) / 1.14x (bf16)
+    # (r4/r5 A/B incl. tools/scan_ab.py, BASELINE.md). 'jnp'/'pallas'
+    # force one path ('pallas' still falls back on unsupported buckets).
     attention_impl: str = "auto"
 
 
@@ -234,24 +234,29 @@ def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask,
     reference-exact scatter mode on supported buckets, jnp otherwise.
 
     ``auto`` routing is evidence-driven (VERDICT r4 item 7): the fused
-    kernel is measured 1.18-1.70x faster on the inference forward at p128
-    but neutral (0.95-1.06x) inside the train step, where attention is
-    <=9% of FLOPs and the step is decoder-bound — so auto uses Pallas only
-    for ``train=False`` applies (forward/eval/predict) and the jnp scatter
-    path for training. Force with attention_impl='pallas'/'jnp' (the
-    bench's A/B does exactly that)."""
+    kernel wins the inference forward outright (1.18-2.06x across r4/r5
+    runs at p128) and is never slower inside the train step — the
+    decision-grade scanned A/B (tools/scan_ab.py, r5) measures train-scan
+    1.016x at b8 float32 (neutral) and 1.14x at b8 bfloat16, where the
+    faster decoder leaves attention a larger share — so auto uses Pallas
+    wherever the kernel supports the (batch, bucket) shape on the Mosaic
+    TPU backend. Force with attention_impl='pallas'/'jnp' (the bench's
+    A/B does exactly that). ``train`` is accepted for signature stability
+    (routing no longer depends on it)."""
+    del train  # routing is shape/backend-driven only (see docstring)
     n = q.shape[1]
     use_pallas = False
     if cfg.attention_mode == "scatter" and cfg.attention_impl in ("auto", "pallas"):
         from deepinteract_tpu.ops.pallas_attention import supports
 
-        if supports(n):
+        if supports(n, batch=q.shape[0], knn=nbr_idx.shape[-1],
+                    hidden=q.shape[-2] * q.shape[-1]):
             if cfg.attention_impl == "pallas":
                 use_pallas = True
-            else:  # auto: inference only, and only on the Mosaic TPU backend
+            else:  # auto: wherever the Mosaic TPU backend is present
                 import jax
 
-                use_pallas = (not train) and jax.default_backend() == "tpu"
+                use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         import jax
 

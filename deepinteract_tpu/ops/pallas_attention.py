@@ -345,10 +345,18 @@ def _bwd(interpret, res, grads):
 edge_attention_pallas.defvjp(_fwd, _bwd)
 
 
-def supports(n: int) -> bool:
+def supports(n: int, batch: int = 1, knn: int = 20, hidden: int = 128) -> bool:
     """Whether the kernel applies to this bucket: whole-graph up to 128
     nodes, edge-block grid (requires the 64-multiple bucket sizes the
-    loader produces) up to the reference's 256-residue regime."""
+    loader produces) up to the reference's 256-residue regime.
+
+    The batch guard bounds the kernel's scoped-vmem stack: blocks carry
+    the whole batch dim, so the [B, N*K, H] edge tensor must fit the
+    ~16 MB vmem stack with headroom (measured: b16 p128 allocates
+    20.17 M and fails AOT compile with 'Ran out of memory in memory
+    space vmem'; b8 p128 at ~10.5 MB compiles and runs)."""
+    if batch * n * knn * hidden * 4 > 12 * 1024 * 1024:
+        return False
     if n <= 128:
         return True
     return n <= MAX_KERNEL_NODES and n % 64 == 0

@@ -66,6 +66,12 @@ def test_supports_guard():
     assert supports(192) and supports(256)
     assert not supports(320)
     assert not supports(200)
+    # Batch guard: blocks carry the batch dim, so the edge tensor must fit
+    # the ~16M vmem stack (b16 p128 fails AOT compile; b8 fits).
+    assert supports(128, batch=8)
+    assert not supports(128, batch=16)
+    assert supports(256, batch=4)
+    assert not supports(256, batch=8)
 
 
 def test_forward_parity_blocked_256(rng):
