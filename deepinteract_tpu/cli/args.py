@@ -82,6 +82,12 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                    help="rematerialize decoder blocks in backward (cuts "
                         "train-step HBM ~4x; required for batch 8 at "
                         "128-pad on a 16G chip)")
+    g.add_argument("--remat_policy", choices=("full", "convs"),
+                   default="full",
+                   help="with --remat: 'full' recomputes whole blocks; "
+                        "'convs' saves conv outputs and recomputes only "
+                        "the elementwise chain (no conv recompute, ~3x "
+                        "the residual memory of 'full')")
     g.add_argument("--unrolled_decoder", action="store_true",
                    help="unroll the decoder's base-ResNet chunks instead "
                         "of nn.scan (the pre-r4 param layout; needed to "
@@ -204,6 +210,7 @@ def configs_from_args(
         use_attention=args.use_interact_attention,
         dropout_rate=args.dropout_rate,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         compute_dtype=args.compute_dtype,
         scan_chunks=not args.unrolled_decoder,
         depad_stats=not args.no_depad_stats,

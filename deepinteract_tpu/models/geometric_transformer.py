@@ -250,7 +250,8 @@ def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask,
         from deepinteract_tpu.ops.pallas_attention import supports
 
         if supports(n, batch=q.shape[0], knn=nbr_idx.shape[-1],
-                    hidden=q.shape[-2] * q.shape[-1]):
+                    hidden=q.shape[-2] * q.shape[-1],
+                    num_heads=q.shape[-2]):
             if cfg.attention_impl == "pallas":
                 use_pallas = True
             else:  # auto: wherever the Mosaic TPU backend is present

@@ -345,7 +345,8 @@ def _bwd(interpret, res, grads):
 edge_attention_pallas.defvjp(_fwd, _bwd)
 
 
-def supports(n: int, batch: int = 1, knn: int = 20, hidden: int = 128) -> bool:
+def supports(n: int, batch: int = 1, knn: int = 20, hidden: int = 128,
+             num_heads: int = 4) -> bool:
     """Whether the kernel applies to this bucket: whole-graph up to 128
     nodes, edge-block grid (requires the 64-multiple bucket sizes the
     loader produces) up to the reference's 256-residue regime.
@@ -354,7 +355,15 @@ def supports(n: int, batch: int = 1, knn: int = 20, hidden: int = 128) -> bool:
     the whole batch dim, so the [B, N*K, H] edge tensor must fit the
     ~16 MB vmem stack with headroom (measured: b16 p128 allocates
     20.17 M and fails AOT compile with 'Ran out of memory in memory
-    space vmem'; b8 p128 at ~10.5 MB compiles and runs)."""
+    space vmem'; b8 p128 at ~10.5 MB compiles and runs).
+
+    The hidden/head floor excludes degenerate-tiling configs: lanes pad
+    the channel dim to 128, so tiny models inflate the stack instead of
+    shrinking it (measured: hidden=8 / head_dim=4 at n=128 allocates
+    16.18 M and fails AOT compile — a smoke config, not a perf target;
+    such models route to the jnp path, where they are fast anyway)."""
+    if hidden < 64 or hidden // max(num_heads, 1) < 16:
+        return False
     if batch * n * knn * hidden * 4 > 12 * 1024 * 1024:
         return False
     if n <= 128:

@@ -301,3 +301,42 @@ def test_depad_stats_large_mean_inputs(rng):
     # the normalized outputs still agree to ~1e-2.
     np.testing.assert_allclose(np.asarray(out_fast), np.asarray(out_ref),
                                rtol=1e-2, atol=1e-2)
+
+
+def test_remat_policy_convs_matches(rng):
+    """The 'convs' checkpoint policy (save conv outputs, recompute only the
+    elementwise chain) must match 'full' remat and no-remat numerics and
+    keep the same param tree, under both the scanned and unrolled layouts
+    and both stats paths."""
+    import dataclasses
+
+    x = jnp.asarray(rng.normal(size=(1, 12, 10, 16)).astype(np.float32))
+    mask = jnp.zeros((1, 12, 10)).at[:, :9, :7].set(1.0)
+    for scan_chunks in (False, True):
+        for depad in (False, True):
+            cfg = small_cfg(num_chunks=2, scan_chunks=scan_chunks,
+                            depad_stats=depad)
+            cfg_c = dataclasses.replace(cfg, remat=True, remat_policy="convs")
+            plain = InteractionDecoder(cfg)
+            conv_pol = InteractionDecoder(cfg_c)
+            variables = plain.init(jax.random.PRNGKey(2), x, mask)
+            variables_c = conv_pol.init(jax.random.PRNGKey(2), x, mask)
+            assert (jax.tree_util.tree_structure(variables)
+                    == jax.tree_util.tree_structure(variables_c))
+
+            np.testing.assert_allclose(
+                np.asarray(plain.apply(variables, x, mask)),
+                np.asarray(conv_pol.apply(variables, x, mask)),
+                rtol=1e-5, atol=1e-5)
+
+            def loss(fn):
+                def f(params):
+                    return jnp.mean(fn.apply({"params": params}, x, mask) ** 2)
+                return f
+
+            g_plain = jax.grad(loss(plain))(variables["params"])
+            g_conv = jax.grad(loss(conv_pol))(variables["params"])
+            for a, b in zip(jax.tree_util.tree_leaves(g_plain),
+                            jax.tree_util.tree_leaves(g_conv)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5)

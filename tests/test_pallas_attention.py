@@ -72,6 +72,11 @@ def test_supports_guard():
     assert not supports(128, batch=16)
     assert supports(256, batch=4)
     assert not supports(256, batch=8)
+    # Tiny-model floor: hidden=8 / head_dim=4 measured a 16.18M vmem
+    # stack AOT failure at n=128 (lane padding inflates small channels).
+    assert not supports(128, hidden=8, num_heads=2)
+    assert not supports(128, hidden=64, num_heads=8)
+    assert supports(128, hidden=64, num_heads=4)
 
 
 def test_forward_parity_blocked_256(rng):
