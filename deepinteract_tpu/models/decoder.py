@@ -53,7 +53,11 @@ class DecoderConfig:
     # recomputed, at ~3x the residual memory of 'full' (3 conv outputs +
     # block input per block vs block input only). The backward's FLOP
     # count is then the no-remat 3x-forward figure. Ignored when ``remat``
-    # is False.
+    # is False. Measured (tools/remat_ab.py, v5e, b8 p128 bf16 scanned):
+    # 'convs' is 0.89x of 'full' — the backward is bandwidth-bound there,
+    # so the larger residual set's HBM traffic outweighs the conv
+    # recompute it saves; 'full' stays the default. The trade can flip on
+    # parts with more HBM bandwidth per FLOP.
     remat_policy: str = "full"
     # Activation compute dtype for the conv stack ('float32' | 'bfloat16').
     # bfloat16 halves HBM traffic on the pair-map activations; params stay

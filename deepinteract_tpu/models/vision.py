@@ -215,6 +215,8 @@ ENCODER_ZOO = {
     "resnet18": ("basic", (2, 2, 2, 2), (64, 128, 256, 512)),
     "resnet34": ("basic", (3, 4, 6, 3), (64, 128, 256, 512)),
     "resnet50": ("bottleneck", (3, 4, 6, 3), (256, 512, 1024, 2048)),
+    "resnet101": ("bottleneck", (3, 4, 23, 3), (256, 512, 1024, 2048)),
+    "resnet152": ("bottleneck", (3, 8, 36, 3), (256, 512, 1024, 2048)),
 }
 
 

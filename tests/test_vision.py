@@ -157,6 +157,10 @@ class TestEncoderZoo:
     def test_zoo_defaults_derive_stage_shapes(self):
         cfg = DeepLabConfig(encoder_name="resnet50")
         assert tuple(cfg.stage_channels) == (256, 512, 1024, 2048)
+        cfg101 = DeepLabConfig(encoder_name="resnet101")
+        assert tuple(cfg101.stage_blocks) == (3, 4, 23, 3)
+        cfg152 = DeepLabConfig(encoder_name="resnet152")
+        assert tuple(cfg152.stage_blocks) == (3, 8, 36, 3)
         cfg18 = DeepLabConfig(encoder_name="resnet18")
         assert tuple(cfg18.stage_blocks) == (2, 2, 2, 2)
         with pytest.raises(ValueError):

@@ -50,6 +50,8 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 REF_TEST_DATA = "/root/reference/project/test_data"
 
 
@@ -225,7 +227,10 @@ def main(argv=None) -> int:
     model_flags = tiny_flags() if args.tiny else []
     results = {}
 
-    input_dir = derive_fragment_pairs(args.work_dir)
+    # Stage C derives its own (cartesian) corpus; the diagonal fragment
+    # set only feeds stages A and B.
+    input_dir = (derive_fragment_pairs(args.work_dir)
+                 if not (args.skip_a and args.skip_b) else None)
 
     if not args.skip_a:
         t0 = time.time()

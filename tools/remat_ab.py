@@ -1,0 +1,121 @@
+"""Scanned-train remat-policy A/B: full-block recompute vs conv-saving
+policy vs no remat.
+
+Full-block remat re-runs the decoder's convs in backward (~one extra
+decoder forward of FLOPs, counted by bench.py's analytic_train_flops);
+the 'convs' checkpoint policy (DecoderConfig.remat_policy) saves conv
+outputs and recomputes only the elementwise chain, and no-remat saves
+everything. Which one wins on the chip depends on whether the saved
+recompute beats the extra HBM traffic of the larger residual set — this
+tool measures all three on the same scanned-dispatch protocol as
+tools/scan_ab.py (single-dispatch timings carry ±10-20% tunnel spread).
+Variants that OOM are reported as such, not crashed on.
+
+Usage: python tools/remat_ab.py [batch] [pad] [dtype]   (defaults 8 128
+bfloat16 — the throughput flagship config)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    from deepinteract_tpu.data.graph import stack_complexes
+    from deepinteract_tpu.data.synthetic import random_complex
+    from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+    from deepinteract_tpu.training.optim import OptimConfig
+    from deepinteract_tpu.training.steps import (
+        create_train_state,
+        multi_train_step,
+        stack_microbatches,
+    )
+
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    pad = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    dtype = sys.argv[3] if len(sys.argv) > 3 else "bfloat16"
+    scan_k = 8
+    n1, n2 = {128: (100, 80), 256: (230, 200)}[pad]
+    rng = np.random.default_rng(0)
+    batch = stack_complexes([
+        random_complex(n1, n2, rng=rng, n_pad1=pad, n_pad2=pad, knn=20,
+                       geo_nbrhd_size=2)
+        for _ in range(bs)
+    ])
+    print(f"device={jax.devices()[0].device_kind} b{bs} p{pad} {dtype} "
+          f"scan{scan_k}", flush=True)
+
+    variants = (("full", True, "full"), ("convs", True, "convs"),
+                ("none", False, "full"))
+    results = {}
+    state_cache = {}
+    for name, remat, policy in variants:
+        base = ModelConfig()
+        model = DeepInteract(dataclasses.replace(
+            base,
+            decoder=dataclasses.replace(base.decoder, remat=remat,
+                                        remat_policy=policy,
+                                        compute_dtype=dtype),
+        ))
+        if "state" not in state_cache:
+            state_cache["state"] = create_train_state(
+                model, jax.tree_util.tree_map(lambda x: x[:1], batch),
+                optim_cfg=OptimConfig(steps_per_epoch=100, num_epochs=50))
+        # Identical param tree across variants — swap only the apply_fn.
+        state = state_cache["state"].replace(apply_fn=model.apply)
+        stacked = stack_microbatches([batch] * scan_k)
+        mstep = jax.jit(lambda s, bst: multi_train_step(s, bst))
+        try:
+            t0 = time.perf_counter()
+            compiled = mstep.lower(state, stacked).compile()
+            compile_s = time.perf_counter() - t0
+
+            def run(ncalls):
+                out = None
+                t0 = time.perf_counter()
+                for _ in range(ncalls):
+                    out = compiled(state, stacked)
+                jax.block_until_ready(out)
+                # Forced host fetch: dispatch-only timing lies via the tunnel.
+                float(np.asarray(jax.device_get(out[1]["loss"])).ravel()[0])
+                return time.perf_counter() - t0
+
+            run(1)  # warmup
+            samples = []
+            for _ in range(3):
+                t1, t2 = run(1), run(2)
+                samples.append((t2 - t1) / scan_k)
+        except Exception as exc:
+            msg = str(exc).splitlines()[0][:300]
+            results[name] = {"error": msg}
+            print(f"{name}: FAILED — {msg}", flush=True)
+            continue
+        per_step = float(np.median(samples))
+        results[name] = {"per_step_ms": per_step * 1e3,
+                         "complexes_per_sec": bs / per_step,
+                         "compile_s": compile_s}
+        print(f"{name}: {per_step*1e3:.2f} ms/step "
+              f"({bs/per_step:.1f} c/s, compile {compile_s:.0f}s)", flush=True)
+
+    if "per_step_ms" in results.get("full", {}):
+        for name in ("convs", "none"):
+            if "per_step_ms" in results.get(name, {}):
+                results[f"{name}_vs_full"] = (
+                    results["full"]["per_step_ms"]
+                    / results[name]["per_step_ms"])
+    print("RESULT " + json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
