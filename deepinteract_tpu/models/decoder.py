@@ -53,11 +53,12 @@ class DecoderConfig:
     # recomputed, at ~3x the residual memory of 'full' (3 conv outputs +
     # block input per block vs block input only). The backward's FLOP
     # count is then the no-remat 3x-forward figure. Ignored when ``remat``
-    # is False. Measured (tools/remat_ab.py, v5e, b8 p128 bf16 scanned):
-    # 'convs' is 0.89x of 'full' — the backward is bandwidth-bound there,
-    # so the larger residual set's HBM traffic outweighs the conv
-    # recompute it saves; 'full' stays the default. The trade can flip on
-    # parts with more HBM bandwidth per FLOP.
+    # is False. Measured (tools/remat_ab.py, v5e p128 bf16 scanned): the
+    # trade is batch-dependent — at b8 'convs' is 0.89x of 'full' (the
+    # backward is bandwidth-bound; the larger residual set's HBM traffic
+    # outweighs the conv recompute it saves), at b4 'convs' wins 1.21x.
+    # 'full' stays the default (b8 is the throughput flagship and full
+    # wins there).
     remat_policy: str = "full"
     # Activation compute dtype for the conv stack ('float32' | 'bfloat16').
     # bfloat16 halves HBM traffic on the pair-map activations; params stay
@@ -107,10 +108,15 @@ def _remat_transform(policy: str):
     return nn.remat
 
 
-def _tag_conv(x):
+def _tag_conv(x, enabled: bool):
     """Mark a conv output as a saved residual for the 'convs' remat
-    policy. A pure name marker: identity in math and a no-op under the
-    'full' policy or outside remat."""
+    policy. Identity in math, but the name marker perturbs XLA's fusion
+    choices (measured: scan-vs-sequential train steps drift past the 5e-5
+    float32 re-association tolerance with markers present), so it is
+    emitted ONLY when the convs policy actually consumes it — default
+    graphs stay byte-identical to the unmarked form."""
+    if not enabled:
+        return x
     from jax.ad_checkpoint import checkpoint_name
 
     return checkpoint_name(x, "decoder_conv")
@@ -320,10 +326,13 @@ class BottleneckBlock(nn.Module):
     use_inorm: bool
     dtype: jnp.dtype = jnp.float32
     depad: bool = False
+    # True only under remat_policy='convs' (see _tag_conv).
+    tag_convs: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, count=None, pad_value=None):
         half = self.channels // 2
+        tag = self.tag_convs
         fast = (self.depad and mask is not None and count is not None
                 and pad_value is not None)
         residual, pv_res = x, pad_value
@@ -338,7 +347,7 @@ class BottleneckBlock(nn.Module):
         if fast:
             pv = nn.elu(pv)
             x, pv = PVConv1x1(half, dtype=self.dtype, name="conv2d_1")(x, pv)
-            x = _tag_conv(x)
+            x = _tag_conv(x, tag)
             if self.use_inorm:
                 x, pv = InstanceNorm(half, name="inorm_2")(
                     x, mask, count=count, pad_value=pv, depad=True)
@@ -347,7 +356,8 @@ class BottleneckBlock(nn.Module):
             x = nn.elu(x) * mask[..., None].astype(x.dtype)
         else:
             x = _tag_conv(
-                nn.Conv(half, (1, 1), dtype=self.dtype, name="conv2d_1")(x))
+                nn.Conv(half, (1, 1), dtype=self.dtype, name="conv2d_1")(x),
+                tag)
             if self.use_inorm:
                 x = InstanceNorm(half, name="inorm_2")(x, mask)
             x = nn.elu(x)
@@ -362,7 +372,7 @@ class BottleneckBlock(nn.Module):
         x = _tag_conv(nn.Conv(
             half, (3, 3), kernel_dilation=(self.dilation, self.dilation),
             padding=self.dilation, dtype=self.dtype, name="conv2d_2",
-        )(x))
+        )(x), tag)
         if fast:
             # Mask 2 of 2: the 3x3 mixed valid values into the boundary
             # band of the pad, so the pad value is no longer uniform;
@@ -377,7 +387,7 @@ class BottleneckBlock(nn.Module):
             pv = nn.elu(pv)
             x, pv = PVConv1x1(self.channels, dtype=self.dtype,
                               name="conv2d_3")(x, pv)
-            x = _tag_conv(x)
+            x = _tag_conv(x, tag)
             x, pv = SEBlock(self.channels, dtype=self.dtype, name="se_block")(
                 x, mask, count=count, pad_value=pv)
             return x + residual, pv + pv_res
@@ -387,7 +397,7 @@ class BottleneckBlock(nn.Module):
             x = InstanceNorm(half, name="inorm_3")(x, mask)
         x = nn.elu(x)
         x = _tag_conv(nn.Conv(self.channels, (1, 1), dtype=self.dtype,
-                              name="conv2d_3")(x))
+                              name="conv2d_3")(x), tag)
         x = SEBlock(self.channels, dtype=self.dtype, name="se_block")(x, mask)
         out = x + residual
         if mask is not None:
@@ -417,6 +427,7 @@ class DilationChunk(nn.Module):
         # 'convs' policy, its conv outputs) and recomputes inside.
         block_cls = (_remat_transform(self.remat_policy)(BottleneckBlock)
                      if self.remat else BottleneckBlock)
+        tag = self.remat and self.remat_policy == "convs"
         if self.depad:
             x, pv = carry
         else:
@@ -424,7 +435,7 @@ class DilationChunk(nn.Module):
         for d in self.dilation_cycle:
             out = block_cls(
                 self.channels, d, self.use_inorm, self.dtype, self.depad,
-                name=f"block_d{d}",
+                tag, name=f"block_d{d}",
             )(x, mask, count, pv)
             x, pv = out if self.depad else (out, None)
         return ((x, pv) if self.depad else x), None
@@ -456,6 +467,7 @@ class DilatedResNet(nn.Module):
                  and pad_value is not None)
         block_cls = (_remat_transform(self.remat_policy)(BottleneckBlock)
                      if self.remat else BottleneckBlock)
+        tag = self.remat and self.remat_policy == "convs"
         pv = pad_value if depad else None
         if self.initial_projection:
             # Tracks the pad value through the projection in fused
@@ -487,14 +499,14 @@ class DilatedResNet(nn.Module):
                 for d in self.dilation_cycle:
                     out = block_cls(
                         self.channels, d, self.use_inorm, self.dtype, depad,
-                        name=f"block_{i}_{d}",
+                        tag, name=f"block_{i}_{d}",
                     )(x, mask, count, pv)
                     x, pv = out if depad else (out, None)
         if self.extra_blocks:
             for i in range(2):
                 out = block_cls(
                     self.channels, 1, self.use_inorm, self.dtype, depad,
-                    name=f"extra_block_{i}",
+                    tag, name=f"extra_block_{i}",
                 )(x, mask, count, pv)
                 x, pv = out if depad else (out, None)
         return x, pv

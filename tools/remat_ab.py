@@ -45,7 +45,12 @@ def main() -> int:
     pad = int(sys.argv[2]) if len(sys.argv) > 2 else 128
     dtype = sys.argv[3] if len(sys.argv) > 3 else "bfloat16"
     scan_k = 8
-    n1, n2 = {128: (100, 80), 256: (230, 200)}[pad]
+    lengths = {128: (100, 80), 256: (230, 200), 384: (370, 350),
+               512: (500, 470)}
+    if pad not in lengths:
+        raise SystemExit(f"unsupported pad {pad}; choose from "
+                         f"{sorted(lengths)}")
+    n1, n2 = lengths[pad]
     rng = np.random.default_rng(0)
     batch = stack_complexes([
         random_complex(n1, n2, rng=rng, n_pad1=pad, n_pad2=pad, knn=20,
@@ -91,19 +96,28 @@ def main() -> int:
                 return time.perf_counter() - t0
 
             run(1)  # warmup
-            samples = []
+            samples, clamped = [], 0
             for _ in range(3):
                 t1, t2 = run(1), run(2)
+                if t2 <= t1:  # differencing noise (same guard as bench.py)
+                    clamped += 1
+                    continue
                 samples.append((t2 - t1) / scan_k)
         except Exception as exc:
             msg = str(exc).splitlines()[0][:300]
             results[name] = {"error": msg}
             print(f"{name}: FAILED — {msg}", flush=True)
             continue
+        if not samples:
+            results[name] = {"error": f"all {clamped} reps hit t2<=t1 "
+                             "differencing noise; timing untrustworthy"}
+            print(f"{name}: FAILED — timing degenerate", flush=True)
+            continue
         per_step = float(np.median(samples))
         results[name] = {"per_step_ms": per_step * 1e3,
                          "complexes_per_sec": bs / per_step,
-                         "compile_s": compile_s}
+                         "compile_s": compile_s,
+                         "clamped_samples": clamped}
         print(f"{name}: {per_step*1e3:.2f} ms/step "
               f"({bs/per_step:.1f} c/s, compile {compile_s:.0f}s)", flush=True)
 
