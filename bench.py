@@ -889,13 +889,17 @@ def _emit_headline(detail, scan_k) -> None:
         min_s = entry.get("train_scan_ms_per_step_min")
         med_s = entry.get("train_scan_ms_per_step")
         proto = entry.get("scan_timing_protocol", {})
-        # The min is only trustworthy when no rep hit the t2<=t1 clamp
-        # sentinel (1e-9, _time_compiled) and it sits close under the
-        # median — a min far below it is differencing noise (inflated t1),
-        # not a faster device.
+        # Differenced-sample minima are biased OPTIMISTIC (interference
+        # inside the t1 run deflates the sample), so the min is only
+        # admitted within a tight band under the median: clean runs
+        # measure a 0.7-2.7% min/median gap, so 10% bounds the possible
+        # overstatement while still rescuing a median inflated by a
+        # loaded host (measured +8% under a concurrent CPU hog, min
+        # within 3% of the quiet-run value). Reps that hit the t2<=t1
+        # clamp sentinel disqualify the min outright.
         min_ok = (min_s and med_s
                   and proto.get("clamped_samples", 1) == 0
-                  and min_s >= 0.8 * med_s)
+                  and min_s >= 0.9 * med_s)
         if min_ok:
             value = bs / (min_s / 1e3)
             protocol = "min of differenced scan samples"
