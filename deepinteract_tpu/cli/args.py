@@ -126,6 +126,11 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--eval_batches_per_dispatch", type=int, default=8,
                    help="eval batches scanned per device dispatch "
                         "(1 = classic per-batch)")
+    g.add_argument("--sync_checkpoint", action="store_true",
+                   help="save checkpoints synchronously instead of "
+                        "overlapping the save with the next epoch's "
+                        "training (debugging, or when the async "
+                        "snapshot's extra state copy does not fit HBM)")
     g.add_argument("--patience", type=int, default=5)
     g.add_argument("--min_delta", type=float, default=5e-6)
     g.add_argument("--metric_to_track", type=str, default="val_ce")
@@ -255,6 +260,7 @@ def configs_from_args(
         viz_every_n_epochs=args.viz_every_n_epochs,
         steps_per_dispatch=args.steps_per_dispatch,
         eval_batches_per_dispatch=args.eval_batches_per_dispatch,
+        async_checkpoint=not args.sync_checkpoint,
     )
     return model_cfg, optim_cfg, loop_cfg
 

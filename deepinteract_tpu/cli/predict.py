@@ -44,7 +44,7 @@ def main(argv=None) -> int:
     from deepinteract_tpu.data.graph import stack_complexes
     from deepinteract_tpu.models.model import DeepInteract
     from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig
-    from deepinteract_tpu.training.loop import Trainer, state_to_tree
+    from deepinteract_tpu.training.loop import Trainer, state_template
 
     model_cfg, optim_cfg, loop_cfg = configs_from_args(args)
 
@@ -67,7 +67,7 @@ def main(argv=None) -> int:
     if args.ckpt_name:
         ckpt = Checkpointer(CheckpointConfig(directory=args.ckpt_name,
                                              metric_to_track=args.metric_to_track))
-        tree = state_to_tree(state)
+        tree = state_template(state)
         restored = ckpt.restore({"params": tree["params"],
                                  "batch_stats": tree["batch_stats"]},
                                 which="best", partial=True)

@@ -72,7 +72,7 @@ def main(argv=None) -> int:
     from deepinteract_tpu.data.loader import BucketedLoader
     from deepinteract_tpu.models.model import DeepInteract
     from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig
-    from deepinteract_tpu.training.loop import Trainer, state_to_tree
+    from deepinteract_tpu.training.loop import Trainer, state_template
 
     model_cfg, optim_cfg, loop_cfg = configs_from_args(args)
     dm = PICPDataModule(
@@ -108,7 +108,7 @@ def main(argv=None) -> int:
     else:
         ckpt = Checkpointer(CheckpointConfig(directory=ckpt_dir,
                                              metric_to_track=args.metric_to_track))
-        tree = state_to_tree(state)
+        tree = state_template(state)
         restored = ckpt.restore({"params": tree["params"],
                                  "batch_stats": tree["batch_stats"]},
                                 which="best", partial=True)

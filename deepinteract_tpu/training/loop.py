@@ -226,7 +226,7 @@ class Trainer:
         )
         if fine_tune_from:
             ckpt = Checkpointer(CheckpointConfig(directory=fine_tune_from))
-            tree = state_to_tree(state)
+            tree = state_template(state)
             target = {"params": tree["params"], "batch_stats": tree["batch_stats"]}
             restored = ckpt.restore(target, which="best", partial=True)
             ckpt.close()
@@ -387,7 +387,7 @@ class Trainer:
         if resume:
             if ckpt is not None and ckpt.latest_step() is not None:
                 state = _restore_into(
-                    state, ckpt.restore(state_to_tree(state), which="last"))
+                    state, ckpt.restore(state_template(state), which="last"))
                 start_epoch = int(ckpt.latest_step())
                 self.log(f"resumed from epoch {start_epoch}")
             if jax.process_count() > 1:
@@ -502,7 +502,9 @@ class Trainer:
             )
 
             if cfg.swa and epoch >= swa_first_epoch:
-                p = jax.tree_util.tree_map(host_local_array, state.params)
+                # Packed fetch where single-process (the per-leaf path
+                # costs one transport round trip per param leaf).
+                p = _fetch_tree(state.params)
                 if swa_params is None:
                     swa_params, swa_count = p, 1
                 else:
@@ -793,6 +795,23 @@ def state_to_tree(state: TrainState):
     host's full local copy (host_local_array), so saving from the primary
     host needs no cross-process coordination."""
     return _fetch_tree(_state_dict(state))
+
+
+def state_template(state: TrainState):
+    """Abstract shape/dtype tree for checkpoint RESTORE targets.
+
+    Restore only needs the tree's structure and leaf shapes/dtypes —
+    building the template via :func:`state_to_tree` paid a full
+    device->host fetch (plus the packed-concat compile) whose values
+    orbax then discarded. ShapeDtypeStructs carry the same information
+    with zero transfers."""
+    def absify(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        arr = np.asarray(x)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    return jax.tree_util.tree_map(absify, _state_dict(state))
 
 
 def _restore_into(state: TrainState, restored) -> TrainState:

@@ -123,10 +123,13 @@ def test_two_process_cli_train(tmp_path):
         assert int(m.group(1)) == 2
 
     # Replicated training: per-epoch metrics printed by both hosts agree.
+    # The train_s=/val_s= phase-timing fields are host wall clocks and
+    # legitimately differ across processes — strip them; every metric
+    # value must still match exactly.
     def epoch_line(out):
         lines = [l for l in out.splitlines() if l.startswith("epoch 0:")]
         assert lines, out[-2000:]
-        return lines[-1]
+        return re.sub(r" (?:train|val)_s=[0-9.]+", "", lines[-1])
 
     assert epoch_line(outs[0]) == epoch_line(outs[1])
 
