@@ -32,7 +32,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_corpus(root: str, n_train: int, n_val: int, n_test: int,
-                 seed: int = 11) -> None:
+                 seed: int = 11, p128_only: bool = False) -> None:
     from deepinteract_tpu.data.features import featurize_chain
     from deepinteract_tpu.data.io import save_complex_npz
     from deepinteract_tpu.data.synthetic import (
@@ -50,7 +50,8 @@ def build_corpus(root: str, n_train: int, n_val: int, n_test: int,
                                geo_nbrhd_size=2, rng=rng), bb
 
     def length():
-        lo, hi = (90, 125) if rng.random() < 0.5 else (200, 250)
+        lo, hi = ((90, 125) if (p128_only or rng.random() < 0.5)
+                  else (200, 250))
         return int(rng.integers(lo, hi + 1))
 
     names = []
@@ -85,6 +86,12 @@ def build_corpus(root: str, n_train: int, n_val: int, n_test: int,
     for mode, chunk in splits.items():
         with open(os.path.join(root, f"pairs-postprocessed-{mode}.txt"), "w") as fh:
             fh.write("\n".join(chunk) + "\n")
+    # Corpus profile manifest: reuse must fail loudly on a flag mismatch
+    # (a mixed-length corpus silently reused under --p128_only would
+    # publish a flagship number measured on a different workload).
+    with open(os.path.join(root, "corpus_meta.json"), "w") as fh:
+        json.dump({"p128_only": p128_only, "n_train": n_train,
+                   "n_val": n_val, "n_test": n_test, "seed": seed}, fh)
 
 
 def main() -> int:
@@ -99,6 +106,16 @@ def main() -> int:
     ap.add_argument("--diagonal_buckets", action="store_true",
                     help="forward cli.train's --diagonal_buckets (2 "
                          "shape-pair compiles on this corpus instead of 4)")
+    ap.add_argument("--batch_size", type=int, default=1,
+                    help="forward cli.train's --batch_size (the flagship "
+                         "throughput config is 8 with --compute_dtype "
+                         "bfloat16 on a 128-bucket corpus)")
+    ap.add_argument("--compute_dtype", default="float32",
+                    choices=("float32", "bfloat16"))
+    ap.add_argument("--p128_only", action="store_true",
+                    help="draw all chain lengths from [90, 125] so every "
+                         "complex lands in the 128 bucket (one shape "
+                         "pair; b8 batches always fill)")
     ap.add_argument("--packed_cache_dir", default=None,
                     help="forward cli.train's --packed_cache_dir (mmap "
                          "batch assembly; pack built on first run)")
@@ -107,9 +124,20 @@ def main() -> int:
     marker = os.path.join(args.root, "pairs-postprocessed-train.txt")
     if not os.path.exists(marker):
         print(f"building corpus at {args.root} ...", flush=True)
-        build_corpus(args.root, args.n_train, args.n_val, args.n_test)
+        build_corpus(args.root, args.n_train, args.n_val, args.n_test,
+                     p128_only=args.p128_only)
     else:
-        print(f"reusing corpus at {args.root}", flush=True)
+        meta_path = os.path.join(args.root, "corpus_meta.json")
+        meta = (json.load(open(meta_path))
+                if os.path.exists(meta_path) else {"p128_only": False})
+        if bool(meta.get("p128_only")) != args.p128_only:
+            raise SystemExit(
+                f"corpus at {args.root} was built with "
+                f"p128_only={meta.get('p128_only')} but this run asks for "
+                f"p128_only={args.p128_only}; use a different --root (the "
+                "length mix changes what the sustained figure measures)")
+        print(f"reusing corpus at {args.root} "
+              f"(p128_only={bool(meta.get('p128_only'))})", flush=True)
     # The throughput denominator comes from the corpus actually used (a
     # reused corpus may differ from --n_train).
     with open(marker) as fh:
@@ -152,6 +180,10 @@ def main() -> int:
         cli_args.append("--diagonal_buckets")
     if args.packed_cache_dir:
         cli_args += ["--packed_cache_dir", args.packed_cache_dir]
+    if args.batch_size != 1:
+        cli_args += ["--batch_size", str(args.batch_size)]
+    if args.compute_dtype != "float32":
+        cli_args += ["--compute_dtype", args.compute_dtype]
     t_start = time.perf_counter()
     rc = train_cli.main(cli_args)
     wall = time.perf_counter() - t_start
