@@ -114,6 +114,10 @@ def derive_cartesian_fragments(work_dir: str, window: int = 100,
     labels = interface_labels(left, right)
 
     input_dir = os.path.join(work_dir, "input_pdbs_c")
+    # Clear any previous derivation: a rerun with a different stride in
+    # the same work_dir must not leave stale windows that build_dataset
+    # would fold into dataset_c alongside the new set.
+    shutil.rmtree(input_dir, ignore_errors=True)
     os.makedirs(input_dir, exist_ok=True)
     n1, n2 = len(left), len(right)
     window = min(window, n1, n2)
@@ -216,6 +220,9 @@ def main(argv=None) -> int:
     p.add_argument("--tiny", action="store_true",
                    help="tiny model (CI-scale smoke, not the proof run)")
     p.add_argument("--epochs_c", type=int, default=30)
+    p.add_argument("--stride_c", type=int, default=15,
+                   help="stage C window stride; smaller = more fragment "
+                        "complexes (denser corpus, more held-out targets)")
     p.add_argument("--skip_a", action="store_true")
     p.add_argument("--skip_b", action="store_true")
     p.add_argument("--skip_c", action="store_true")
@@ -270,7 +277,8 @@ def main(argv=None) -> int:
 
     if not args.skip_c:
         t0 = time.time()
-        input_dir_c, names = derive_cartesian_fragments(args.work_dir)
+        input_dir_c, names = derive_cartesian_fragments(
+            args.work_dir, stride=args.stride_c)
         root_c = os.path.join(args.work_dir, "dataset_c")
         build_dataset(input_dir_c, root_c)
         train, val, test = heldout_split(names)

@@ -7,15 +7,19 @@ backend and reports what the Trainer actually sustains — prefetching,
 shape runs, scanned dispatch, eval, checkpointing included — next to the
 micro-bench scan figure.
 
-Corpus note: chain lengths are drawn from [90, 125] and [200, 250]
-(50/50), so complexes land in the 128/256 buckets only. That bounds the
-number of distinct (bucket1, bucket2) executable shapes at 4 — a full
-DIPS run over all four buckets pays up to 16 train-scan compiles, which
-is the documented compile tax, not a measurement artifact.
+Corpus note: by default chain lengths are drawn from [90, 125] and
+[200, 250] (50/50), so complexes land in the 128/256 buckets only —
+at most 4 distinct (bucket1, bucket2) executable shapes (a full DIPS
+run over all four buckets pays up to 16 train-scan compiles, which is
+the documented compile tax, not a measurement artifact). With
+``--p128_only`` every length comes from [90, 125]: one bucket, one
+shape pair, full batches — the flagship-throughput workload.
 
 Usage:
     python tools/sustained_train.py [--n_train 1000] [--epochs 3]
         [--out /tmp/sustained_train.json]
+        [--packed_cache_dir DIR] [--diagonal_buckets]
+        [--p128_only --batch_size 8 --compute_dtype bfloat16]  # flagship
 """
 
 from __future__ import annotations
@@ -78,20 +82,23 @@ def build_corpus(root: str, n_train: int, n_val: int, n_test: int,
             print(f"  built {i + 1}/{total} "
                   f"({(time.perf_counter() - t0):.0f}s)", flush=True)
 
+    # Corpus profile manifest FIRST: reuse must fail loudly on a flag
+    # mismatch (a mixed-length corpus silently reused under --p128_only
+    # would publish a flagship number measured on a different workload),
+    # and the reuse marker is the LAST file written so an interrupted
+    # build can never present a marker without its manifest.
+    with open(os.path.join(root, "corpus_meta.json"), "w") as fh:
+        json.dump({"p128_only": p128_only, "n_train": n_train,
+                   "n_val": n_val, "n_test": n_test, "seed": seed}, fh)
     splits = {
-        "train": names[:n_train],
         "val": names[n_train:n_train + n_val],
         "test": names[n_train + n_val:],
+        # train last: its presence is the reuse marker.
+        "train": names[:n_train],
     }
     for mode, chunk in splits.items():
         with open(os.path.join(root, f"pairs-postprocessed-{mode}.txt"), "w") as fh:
             fh.write("\n".join(chunk) + "\n")
-    # Corpus profile manifest: reuse must fail loudly on a flag mismatch
-    # (a mixed-length corpus silently reused under --p128_only would
-    # publish a flagship number measured on a different workload).
-    with open(os.path.join(root, "corpus_meta.json"), "w") as fh:
-        json.dump({"p128_only": p128_only, "n_train": n_train,
-                   "n_val": n_val, "n_test": n_test, "seed": seed}, fh)
 
 
 def main() -> int:
