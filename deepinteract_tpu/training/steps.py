@@ -132,6 +132,51 @@ def stack_microbatches(batches):
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *batches)
 
 
+def pack_tree(tree):
+    """Host pytree -> ``(buffers, spec)`` for single-transfer dispatch.
+
+    Host->device placement of a jit call's arguments pays a fixed
+    transport round trip PER LEAF on remote-dispatch backends (the axon
+    tunnel; same O(leaves) disease the checkpoint fetch had
+    device->host, training/loop.py:_packed_device_get). Packing the
+    ~20-leaf stacked batch into ONE contiguous host buffer per dtype
+    makes the upload O(dtypes); :func:`unpack_tree` re-slices it inside
+    the jitted step (static offsets — XLA folds the slices/reshapes into
+    the consumers, so device math is unchanged).
+
+    ``spec`` is hashable: pass it as a static jit argument."""
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    by_dtype: Dict[str, list] = {}
+    arrs = [np.asarray(x) for x in leaves]
+    for i, a in enumerate(arrs):
+        by_dtype.setdefault(a.dtype.name, []).append(i)
+    buffers = {}
+    info = [None] * len(leaves)
+    for dname, idxs in by_dtype.items():
+        parts, off = [], 0
+        for i in idxs:
+            a = arrs[i]
+            parts.append(a.ravel())
+            info[i] = (dname, off, a.shape)
+            off += a.size
+        buffers[dname] = np.concatenate(parts)
+    return buffers, (treedef, tuple(info))
+
+
+def unpack_tree(buffers, spec):
+    """Inverse of :func:`pack_tree`, traceable under jit (static spec)."""
+    treedef, info = spec
+    leaves = []
+    for dname, off, shape in info:
+        n = 1
+        for s in shape:
+            n *= s
+        leaves.append(buffers[dname][off : off + n].reshape(shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def eval_step(
     state: TrainState, batch: PairedComplex, weight_classes: bool = False
 ) -> Dict[str, jnp.ndarray]:
