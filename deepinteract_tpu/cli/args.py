@@ -162,6 +162,25 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--num_pair_shards", type=int, default=1,
                    help="context-parallel shards of the pair map")
 
+    g = p.add_argument_group("fault tolerance")
+    g.add_argument("--no_nonfinite_guard", action="store_true",
+                   help="disable the on-device non-finite step guard "
+                        "(robustness/guards.py; by default NaN/inf steps "
+                        "skip the optimizer update instead of poisoning "
+                        "the weights)")
+    g.add_argument("--max_bad_steps", type=int, default=10,
+                   help="abort with a diagnostic dump after this many "
+                        "CONSECUTIVE non-finite (skipped) train steps")
+    g.add_argument("--no_preemption_guard", action="store_true",
+                   help="do not install SIGTERM/SIGINT handlers around "
+                        "fit (by default a preemption flushes the last/ "
+                        "checkpoint and exits 0; rerun with --resume)")
+    g.add_argument("--data_skip_budget", type=int, default=0,
+                   help="train batches per epoch that may be skipped (and "
+                        "logged) when a complex fails to load, instead of "
+                        "killing the epoch; over budget still raises. "
+                        "Single-host only (0 = fail fast)")
+
 
 def add_logging_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("logging")
@@ -261,6 +280,9 @@ def configs_from_args(
         steps_per_dispatch=args.steps_per_dispatch,
         eval_batches_per_dispatch=args.eval_batches_per_dispatch,
         async_checkpoint=not args.sync_checkpoint,
+        nonfinite_guard=not getattr(args, "no_nonfinite_guard", False),
+        max_bad_steps=getattr(args, "max_bad_steps", 10),
+        preemption_guard=not getattr(args, "no_preemption_guard", False),
     )
     return model_cfg, optim_cfg, loop_cfg
 

@@ -114,11 +114,17 @@ def main(argv=None) -> int:
         train_ds, val_ds, test_ds = (
             PackedDataset(_os.path.join(args.packed_cache_dir, split))
             for split, *_ in specs)
+    if args.data_skip_budget and shard:
+        # A host-local batch skip would desync step counts across hosts
+        # and deadlock the collectives; the loader enforces the same rule.
+        print("multi-host run: --data_skip_budget disabled (skips must "
+              "agree across hosts)")
     train_loader = BucketedLoader(
         train_ds, batch_size=args.batch_size, shuffle=True, drop_remainder=True,
         seed=args.seed, pad_to_max_bucket=args.pad_to_max_bucket, shard=shard,
         dispatch_run=max(1, args.steps_per_dispatch),
         diagonal_buckets=args.diagonal_buckets,
+        skip_budget=0 if shard else args.data_skip_budget,
     )
     if shard:
         print(f"host {shard[0]}/{shard[1]}: {train_loader.num_batches()} "
@@ -171,10 +177,19 @@ def main(argv=None) -> int:
         import jax
 
         profile = jax.profiler.trace(args.profile_dir)
-    with profile:
-        state, history = trainer.fit(
-            state, train_loader, val_data=val_loader, resume=args.resume
-        )
+    from deepinteract_tpu.robustness.preemption import TrainingPreempted
+
+    try:
+        with profile:
+            state, history = trainer.fit(
+                state, train_loader, val_data=val_loader, resume=args.resume
+            )
+    except TrainingPreempted as exc:
+        # Clean preemption exit (robustness/preemption.py): the last/
+        # checkpoint is flushed; the scheduler restarts us with --resume.
+        print(f"training preempted ({exc}); checkpoint state is flushed — "
+              f"rerun with --resume to continue from epoch boundaries")
+        return 0
 
     # Publish the checkpoint directory as this run's model artifact
     # (Lightning WandbLogger log_model convention; restored by cli.test

@@ -1,26 +1,49 @@
-"""Artifact download with checksum verification.
+"""Artifact download with checksum verification and transient-fault retry.
 
 Reference equivalent: the datasets' Zenodo download + sha1 gate
 (``DIPSDGLDataset.download``, dips_dgl_dataset.py:151-170) and the
 published-checkpoint pointers (README.md:249-253, Zenodo record 6671582).
 Network access is environment-dependent; everything here degrades to a
 clear error message rather than a silent partial tree.
+
+Fault tolerance (robustness/retry.py):
+
+* transient failures — ``URLError`` (connection refused/reset, DNS),
+  socket timeouts, truncated bodies (Content-Length mismatch), HTTP
+  5xx/429 — are retried with exponential backoff + jitter *before* the
+  sha1 gate ever sees the file;
+* permanent failures — HTTP 4xx, and a completed download whose sha1
+  does not match — hard-fail immediately with the original error (a
+  checksum mismatch on a complete body means the artifact is wrong, not
+  the network);
+* every fetch carries an explicit socket timeout (``DI_DOWNLOAD_TIMEOUT``
+  seconds, default 60) — the stock ``urlretrieve`` blocks forever on a
+  stalled peer, which is how unattended dataset builds hang for days.
 """
 
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import shutil
 import tempfile
 import urllib.request
 from typing import Optional
+from urllib.error import ContentTooShortError, HTTPError, URLError
+
+from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.robustness.retry import retry
+
+logger = logging.getLogger(__name__)
 
 # Reference-published artifacts (README.md:249-253; dataset READMEs).
 KNOWN_ARTIFACTS = {
     "checkpoints": "https://zenodo.org/record/6671582",
     "dips_plus": "https://zenodo.org/record/5134732",
 }
+
+DEFAULT_TIMEOUT_SECONDS = 60.0
 
 
 def sha1_of(path: str, chunk: int = 1 << 20) -> str:
@@ -34,25 +57,75 @@ def sha1_of(path: str, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
+def _is_transient(exc: BaseException) -> bool:
+    """Retry triage: HTTP 4xx is a permanent answer from the server; 5xx,
+    429, and every other URLError/timeout/truncation is transient."""
+    if isinstance(exc, HTTPError):
+        return exc.code >= 500 or exc.code == 429
+    return isinstance(exc, (URLError, ContentTooShortError, TimeoutError, OSError))
+
+
+@retry(
+    exceptions=(URLError, ContentTooShortError, TimeoutError, OSError),
+    retryable=_is_transient,
+    max_attempts=4,
+    base_delay=1.0,
+    max_delay=30.0,
+    label="download.fetch",
+)
+def _fetch(url: str, tmp: str, timeout: float) -> None:
+    """One streaming download attempt into ``tmp`` (truncation-checked)."""
+    faults.maybe_raise(
+        "download.fetch",
+        lambda: URLError("injected transient network failure"),
+    )
+    with urllib.request.urlopen(url, timeout=timeout) as resp, open(tmp, "wb") as f:
+        shutil.copyfileobj(resp, f, length=1 << 20)
+        written = f.tell()
+    expected = resp.headers.get("Content-Length")
+    if expected is not None and written != int(expected):
+        raise ContentTooShortError(
+            f"retrieved {written} of {expected} bytes from {url}", None
+        )
+
+
 def download_and_verify(url: str, dest: str, sha1: Optional[str] = None,
-                        overwrite: bool = False) -> str:
+                        overwrite: bool = False,
+                        timeout: Optional[float] = None) -> str:
     """Fetch ``url`` into ``dest``, verifying sha1 when given (the
-    reference hard-fails on checksum mismatch; so do we). Returns dest."""
+    reference hard-fails on checksum mismatch; so do we). Returns dest.
+
+    An existing ``dest`` with a failing checksum raises unless
+    ``overwrite=True``, which deletes and refetches it; the replacement is
+    staged in a temp file and moved into place atomically, so a crash
+    mid-download never leaves a half-written ``dest``.
+    """
     if os.path.exists(dest) and not overwrite:
         if sha1 and sha1_of(dest) != sha1:
             raise ValueError(
                 f"{dest} exists but fails its sha1 check; pass overwrite=True"
             )
         return dest
+    if timeout is None:
+        raw = os.environ.get("DI_DOWNLOAD_TIMEOUT")
+        try:
+            timeout = float(raw) if raw is not None else DEFAULT_TIMEOUT_SECONDS
+        except ValueError:
+            # Same lenient policy as the DI_RETRY_* knobs: a typo'd env
+            # var must not kill an unattended build.
+            logger.warning("ignoring malformed DI_DOWNLOAD_TIMEOUT=%r", raw)
+            timeout = DEFAULT_TIMEOUT_SECONDS
     os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest) or ".")
     os.close(fd)
     try:
-        urllib.request.urlretrieve(url, tmp)
+        _fetch(url, tmp, timeout)
         if sha1:
             got = sha1_of(tmp)
             if got != sha1:
                 raise ValueError(f"sha1 mismatch for {url}: {got} != {sha1}")
+        if overwrite and os.path.exists(dest):
+            logger.info("overwrite: replacing %s (failed or forced)", dest)
         shutil.move(tmp, dest)
     finally:
         if os.path.exists(tmp):

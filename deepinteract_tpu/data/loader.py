@@ -16,6 +16,7 @@ batches.
 
 from __future__ import annotations
 
+import logging
 import queue
 import random
 import threading
@@ -26,6 +27,9 @@ import numpy as np
 
 from deepinteract_tpu.data.graph import PairedComplex, pick_bucket, stack_complexes
 from deepinteract_tpu.data.io import to_paired_complex
+from deepinteract_tpu.robustness import faults
+
+logger = logging.getLogger(__name__)
 
 
 def make_bucket_fn(pad_to_max_bucket: bool = False,
@@ -66,6 +70,7 @@ class BucketedLoader:
         shard: Optional[Tuple[int, int]] = None,
         dispatch_run: int = 1,
         diagonal_buckets: bool = False,
+        skip_budget: int = 0,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -109,6 +114,21 @@ class BucketedLoader:
         self.shard = shard
         if shard is not None:
             assert 0 <= shard[0] < shard[1], shard
+        # Corrupt-complex tolerance: up to this many BATCHES per epoch may
+        # be skipped (logged, counted) when an item fails to load/pad,
+        # instead of one bad npz killing a multi-hour epoch; over budget
+        # the original error is re-raised (a corrupt *dataset* must still
+        # be loud). The whole batch is dropped, not just the item — a
+        # shrunken batch would change shapes and break bucketed compile
+        # reuse. 0 disables (fail-fast, the previous behavior).
+        if skip_budget and shard is not None:
+            # A host-local skip would desynchronize step counts across
+            # hosts and deadlock the global collectives mid-epoch.
+            raise ValueError(
+                "skip_budget requires unsharded loading (multi-host "
+                "training cannot skip batches on one host only)"
+            )
+        self.skip_budget = max(0, skip_budget)
         self._bucket_fn = None  # built once on first _item_bucket call
         # Bucket planning reads every header once, up front.
         self._buckets = self._plan()
@@ -193,28 +213,41 @@ class BucketedLoader:
 
     def _produce(self, epoch: int, with_targets: bool) -> Iterator:
         padded_batch = getattr(self.dataset, "padded_batch", None)
+        skips_left = self.skip_budget
         for (b1, b2), chunk in self._epoch_plan(epoch):
             chunk = self._host_slice(chunk)
-            if padded_batch is not None:
-                # Packed fast path (data/packed.py): mmap rows + stack —
-                # no npz decompress, no padding work.
-                batch = padded_batch(chunk, (b1, b2))
-                if with_targets:
-                    yield batch, [self.dataset.target_of(i) for i in chunk]
-                else:
-                    yield batch
-                continue
-            complexes, targets = [], []
-            for idx in chunk:
-                raw = self.dataset[idx]
-                complexes.append(
-                    to_paired_complex(
-                        raw, n_pad1=b1, n_pad2=b2,
-                        input_indep=raw.get("input_indep", False),
-                    )
+            try:
+                faults.maybe_raise(
+                    "loader.batch",
+                    lambda: ValueError("injected corrupt complex"),
                 )
-                targets.append(raw.get("target", str(idx)))
-            batch = stack_complexes(complexes)
+                if padded_batch is not None:
+                    # Packed fast path (data/packed.py): mmap rows + stack
+                    # — no npz decompress, no padding work.
+                    batch = padded_batch(chunk, (b1, b2))
+                    targets = [self.dataset.target_of(i) for i in chunk]
+                else:
+                    complexes, targets = [], []
+                    for idx in chunk:
+                        raw = self.dataset[idx]
+                        complexes.append(
+                            to_paired_complex(
+                                raw, n_pad1=b1, n_pad2=b2,
+                                input_indep=raw.get("input_indep", False),
+                            )
+                        )
+                        targets.append(raw.get("target", str(idx)))
+                    batch = stack_complexes(complexes)
+            except Exception as exc:
+                if skips_left <= 0:
+                    raise
+                skips_left -= 1
+                logger.warning(
+                    "skipping corrupt batch (bucket %sx%s, items %s): %s "
+                    "— %d skip(s) left this epoch",
+                    b1, b2, chunk, exc, skips_left,
+                )
+                continue
             yield (batch, targets) if with_targets else batch
 
     def iter_epoch(self, epoch: int = 0, with_targets: bool = False) -> Iterator:

@@ -122,6 +122,28 @@ def shard_filenames_for_host(
     return padded[start : start + per_host]
 
 
+def assert_same_across_hosts(values, fail_message: str) -> None:
+    """Assert a small host-side value agrees on every process (no-op
+    single-process).
+
+    The host-agreement primitive behind ``Trainer.evaluate``'s
+    first-batch/loader-length fingerprint check. Only call it from code
+    paths that EVERY host executes at the same point (it is a
+    collective); asymmetric paths — e.g. an abort only some hosts take —
+    must rely on replicated-by-construction values instead (see the
+    non-finite guard: robustness/guards.py branches on the pmean'd
+    loss/grads, so its decisions agree without a collective). Costs one
+    tiny collective; keep it OFF hot paths."""
+    if jax.process_count() <= 1:
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    multihost_utils.assert_equal(
+        np.asarray(values, dtype=np.float32), fail_message=fail_message
+    )
+
+
 def is_primary_host() -> bool:
     """True on the process that should write checkpoints/logs (rank-0
     semantics of the reference's Lightning callbacks)."""

@@ -18,15 +18,22 @@ from deepinteract_tpu.parallel.mesh import DATA_AXIS, PAIR_AXIS
 from deepinteract_tpu.training.steps import TrainState, train_step
 
 
-def make_sharded_train_step(mesh: Mesh, weight_classes: bool = False, donate: bool = True):
+def make_sharded_train_step(mesh: Mesh, weight_classes: bool = False, donate: bool = True,
+                            guard: bool = False):
     """jit ``train_step`` with state replicated and the batch split over the
     ``data`` axis. Gradients become pmean automatically through the
     batch-mean loss under GSPMD.
+
+    ``guard`` enables the non-finite step guard (robustness/guards.py).
+    Under GSPMD the guarded ``lax.cond`` branches on the globally-reduced
+    loss/grad-norm — replicated values, so every device and host takes the
+    same branch; no extra collective is needed for agreement.
     """
     replicated = NamedSharding(mesh, P())
     batch_sharded = NamedSharding(mesh, P(DATA_AXIS))
 
-    step = partial(train_step, weight_classes=weight_classes, axis_name=None)
+    step = partial(train_step, weight_classes=weight_classes, axis_name=None,
+                   guard=guard)
     return jax.jit(
         step,
         in_shardings=(replicated, batch_sharded),
@@ -35,16 +42,19 @@ def make_sharded_train_step(mesh: Mesh, weight_classes: bool = False, donate: bo
     )
 
 
-def make_sharded_multi_step(mesh: Mesh, weight_classes: bool = False, donate: bool = True):
+def make_sharded_multi_step(mesh: Mesh, weight_classes: bool = False, donate: bool = True,
+                            guard: bool = False):
     """Sharded :func:`deepinteract_tpu.training.steps.multi_train_step`:
     the stacked batch is [K, B, ...] with the scan axis unsharded and the
-    batch axis split over ``data``."""
+    batch axis split over ``data``. ``guard`` as in
+    :func:`make_sharded_train_step` (per scanned step)."""
     from deepinteract_tpu.training.steps import multi_train_step
 
     replicated = NamedSharding(mesh, P())
     batch_sharded = NamedSharding(mesh, P(None, DATA_AXIS))
 
-    step = partial(multi_train_step, weight_classes=weight_classes, axis_name=None)
+    step = partial(multi_train_step, weight_classes=weight_classes, axis_name=None,
+                   guard=guard)
     return jax.jit(
         step,
         in_shardings=(replicated, batch_sharded),

@@ -5,17 +5,32 @@ and cached next to the source (keyed by source mtime), so the repo needs no
 ahead-of-time build step. Every kernel has a vectorized numpy fallback in
 :mod:`deepinteract_tpu.pipeline.residue_features`; ``available()`` lets
 callers pick, and the parity tests drive both paths on the same inputs.
+
+Fault tolerance: the compiler subprocess is retried with backoff on
+transient failures (OOM-killed cc1plus, NFS hiccups, timeouts —
+robustness/retry.py); a missing compiler or a genuine compile error is
+permanent and fails once. A failure latches ``available() -> False`` for
+the process lifetime *with the reason logged once* (the old silent
+NumPy-fallback downgrade hid real misconfiguration for whole runs);
+:func:`reset` is the documented escape hatch that clears the latch after
+the operator fixes the environment (e.g. installs g++ mid-session).
 """
 
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 from typing import Optional
 
 import numpy as np
+
+from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.robustness.retry import retry
+
+logger = logging.getLogger(__name__)
 
 _SRC = os.path.join(os.path.dirname(__file__), "native", "geomfeats.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "native", "_build")
@@ -24,15 +39,41 @@ _LIB_PATH = os.path.join(_BUILD_DIR, "geomfeats.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+_disabled_reason: Optional[str] = None
 
 _f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+
+
+def _compile_retryable(exc: BaseException) -> bool:
+    """FileNotFoundError (no compiler) and CalledProcessError (the source
+    does not compile) are deterministic; everything else — OOM kills,
+    timeouts, shared-FS races — is worth another attempt."""
+    return not isinstance(
+        exc, (FileNotFoundError, subprocess.CalledProcessError)
+    )
+
+
+@retry(
+    exceptions=(subprocess.SubprocessError, OSError),
+    retryable=_compile_retryable,
+    max_attempts=3,
+    base_delay=0.5,
+    max_delay=10.0,
+    label="native.compile",
+)
+def _run_compiler(cmd) -> None:
+    faults.maybe_raise(
+        "native.compile", lambda: OSError("injected transient compile failure")
+    )
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
 
 
 def _compile() -> bool:
     """Compile to a process-unique temp name, then atomically rename into
     place: concurrent builders (multi-host training, parallel dataset
     builds on a shared FS) never dlopen a half-written .so."""
+    global _disabled_reason
     os.makedirs(_BUILD_DIR, exist_ok=True)
     tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = [
@@ -40,10 +81,14 @@ def _compile() -> bool:
         "-std=c++17", _SRC, "-o", tmp_path,
     ]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        _run_compiler(cmd)
         os.replace(tmp_path, _LIB_PATH)
         return True
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as exc:
+        detail = exc
+        if isinstance(exc, subprocess.CalledProcessError) and exc.stderr:
+            detail = exc.stderr.decode(errors="replace").strip()[-500:]
+        _disabled_reason = f"compile failed ({cmd[0]}): {detail}"
         try:
             os.unlink(tmp_path)
         except OSError:
@@ -52,7 +97,7 @@ def _compile() -> bool:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _load_failed
+    global _lib, _load_failed, _disabled_reason
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
@@ -61,21 +106,23 @@ def _load() -> Optional[ctypes.CDLL]:
             or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
         )
         if stale and not _compile():
-            _load_failed = True
+            _latch_failure()
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
+        except OSError as exc:
             # A racing process may have just replaced the file; one rebuild
             # -and-retry before latching the failure for process lifetime.
             if _compile():
                 try:
                     lib = ctypes.CDLL(_LIB_PATH)
-                except OSError:
-                    _load_failed = True
+                except OSError as exc2:
+                    _disabled_reason = f"dlopen failed after rebuild: {exc2}"
+                    _latch_failure()
                     return None
             else:
-                _load_failed = True
+                _disabled_reason = _disabled_reason or f"dlopen failed: {exc}"
+                _latch_failure()
                 return None
         lib.sasa_and_depth.argtypes = [
             _f32p, _f32p, ctypes.c_int, ctypes.c_int, ctypes.c_float, _f32p, _f32p,
@@ -92,6 +139,44 @@ def _load() -> Optional[ctypes.CDLL]:
             fn.restype = None
         _lib = lib
         return _lib
+
+
+def _latch_failure() -> None:
+    """Disable the native path for the rest of the process, logging WHY
+    exactly once — feature parity silently degrading to the (slower)
+    NumPy fallback must be visible in run logs. Call under ``_lock``."""
+    global _load_failed
+    if not _load_failed:
+        logger.warning(
+            "native geometry kernels disabled for this process: %s — "
+            "falling back to the NumPy reference path; call "
+            "pipeline.native.reset() to re-attempt after fixing the "
+            "environment", _disabled_reason or "unknown failure",
+        )
+    _load_failed = True
+
+
+def reset() -> None:
+    """Clear the compile/load failure latch (and any cached handle).
+
+    The latch is per-process-lifetime by design — retrying a broken
+    compiler on every featurized chain would add minutes of subprocess
+    churn. This is the documented escape hatch for long-lived processes
+    whose environment was fixed in place (compiler installed, NFS quota
+    freed): the next ``available()``/kernel call re-attempts the build.
+    """
+    global _lib, _load_failed, _disabled_reason
+    with _lock:
+        _lib = None
+        _load_failed = False
+        _disabled_reason = None
+
+
+def disabled_reason() -> Optional[str]:
+    """Why the native path is disabled (None when it is not)."""
+    if os.environ.get("DI_DISABLE_NATIVE"):
+        return "DI_DISABLE_NATIVE is set"
+    return _disabled_reason if _load_failed else None
 
 
 def available() -> bool:

@@ -27,6 +27,8 @@ import numpy as np
 from deepinteract_tpu import constants
 from deepinteract_tpu.pipeline import residue_features as rf
 from deepinteract_tpu.pipeline.pdb import Chain
+from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.robustness.retry import retry
 
 logger = logging.getLogger(__name__)
 
@@ -97,7 +99,41 @@ def sequence_profile(sequence: str) -> np.ndarray:
     return np.zeros((n, constants.NUM_SEQUENCE_FEATS), dtype=np.float32)
 
 
+def _hhblits_retryable(exc: BaseException) -> bool:
+    """Transient vs deterministic triage: timeouts, kill-signal deaths
+    (negative returncode, or the shell-style 128+N codes an OOM killer /
+    scheduler produces) and I/O errors are worth another attempt; an
+    hhblits that exits with an ordinary error code (bad database path,
+    malformed invocation) will fail identically every time — retrying it
+    3x per chain would add hours of wasted backoff to a DIPS-scale
+    featurization run before the zero-fill fallback surfaces the
+    misconfiguration."""
+    if isinstance(exc, subprocess.TimeoutExpired):
+        return True
+    if isinstance(exc, subprocess.CalledProcessError):
+        return exc.returncode < 0 or exc.returncode > 128
+    return isinstance(exc, OSError) and not isinstance(exc, FileNotFoundError)
+
+
+# HH-suite invocations fail transiently in bulk featurization — databases
+# on contended shared filesystems, OOM-killed workers, stray signals — and
+# one flake used to zero an entire chain's 27-d profile. Retry the whole
+# attempt (fresh temp dir per try: a half-written .hhm never leaks into
+# the parse); a deterministic hhblits failure fails fast (one attempt)
+# and propagates to sequence_profile's documented zero-fill warning path.
+@retry(
+    exceptions=(subprocess.SubprocessError, OSError),
+    retryable=_hhblits_retryable,
+    max_attempts=3,
+    base_delay=2.0,
+    max_delay=60.0,
+    label="hhblits.run",
+)
 def _run_hhblits(sequence: str, bin_path: str, db_path: str) -> np.ndarray:
+    faults.maybe_raise(
+        "hhblits.run",
+        lambda: subprocess.CalledProcessError(137, bin_path),
+    )
     with tempfile.TemporaryDirectory() as tmp:
         fasta = os.path.join(tmp, "query.fasta")
         hhm = os.path.join(tmp, "query.hhm")

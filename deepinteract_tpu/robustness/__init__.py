@@ -1,0 +1,42 @@
+"""Fault-tolerance layer: keep long unattended runs alive.
+
+A production-scale training/serving system dies in one of a handful of
+well-known ways — a NaN gradient poisons the weights, a TPU-pool
+preemption kills the process mid-epoch, a transient network or subprocess
+hiccup aborts a multi-hour feature build. Each failure mode gets a
+dedicated, individually-testable module here:
+
+* :mod:`guards` — on-device non-finite step guard (skip bad optimizer
+  updates, count consecutive skips, abort with diagnostics past a budget);
+* :mod:`preemption` — SIGTERM/SIGINT-safe training (clean checkpoint
+  flush + verified ``--resume`` round trip);
+* :mod:`retry` — exponential backoff with jitter and a deadline for
+  flaky I/O and native tooling (downloads, compiles, HH-suite);
+* :mod:`faults` — deterministic fault injection powering the chaos test
+  suite (``tests/test_fault_tolerance.py``) and manual game-days.
+
+Everything is dependency-free (stdlib + numpy/jax already in the tree)
+and degrades to zero overhead when disabled.
+
+``guards`` re-exports are lazy (PEP 562): the CPU-only consumers of this
+package — downloads, native compiles, HH-suite featurization workers —
+must not drag jax/optax (multi-second imports that can claim accelerator
+devices) into processes that never train.
+"""
+
+from deepinteract_tpu.robustness.preemption import (  # noqa: F401
+    PreemptionGuard,
+    TrainingPreempted,
+)
+from deepinteract_tpu.robustness.retry import retry  # noqa: F401
+
+_GUARD_EXPORTS = ("NonFiniteTrainingError", "apply_guarded_update",
+                  "step_is_finite")
+
+
+def __getattr__(name):
+    if name in _GUARD_EXPORTS:
+        from deepinteract_tpu.robustness import guards
+
+        return getattr(guards, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,151 @@
+"""Deterministic fault injection for the chaos test suite.
+
+Fault sites are named probe points compiled into the failure-prone layers
+(download fetch, native compile, HH-suite invoke, loader batch assembly,
+train-step batches). Each site counts its calls; a fault plan maps sites
+to the 1-based call numbers that should fail. Plans are exact and
+deterministic — no randomness — so every chaos test (and every operator
+game-day) reproduces bit-for-bit.
+
+Plan syntax (``DI_FAULTS`` env var or :func:`configure`)::
+
+    site=N          first N calls fault       download.fetch=2
+    site=@i,j,k     exactly calls i, j, k     train.nan_batch=@3
+    plan;plan;...   multiple sites            download.fetch=2;train.sigterm=@6
+
+Registered sites:
+
+* ``download.fetch``   — raises URLError (transient network failure)
+* ``native.compile``   — raises OSError before the compiler subprocess
+* ``hhblits.run``      — raises CalledProcessError before hhblits runs
+* ``loader.batch``     — raises ValueError while assembling a batch
+* ``train.nan_batch``  — poisons every float leaf of the batch with NaN
+* ``train.sigterm``    — requests preemption (simulated SIGTERM) at that
+  train batch
+
+When no plan is configured every probe is a dict lookup on an empty map —
+effectively free on hot paths.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Set, Union
+
+_lock = threading.Lock()
+_plan: Optional[Dict[str, Set[int]]] = None  # None -> read env lazily
+_counts: Dict[str, int] = {}
+
+
+def _parse(spec: str) -> Dict[str, Set[int]]:
+    plan: Dict[str, Set[int]] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, eq, val = part.partition("=")
+        site, val = site.strip(), val.strip()
+        if not eq or not site or not val:
+            raise ValueError(f"malformed fault spec {part!r} (want site=N "
+                             "or site=@i,j,k)")
+        if val.startswith("@"):
+            plan[site] = {int(v) for v in val[1:].split(",") if v.strip()}
+        else:
+            plan[site] = set(range(1, int(val) + 1))
+    return plan
+
+
+def configure(plan: Union[str, Dict[str, object], None]) -> None:
+    """Install a fault plan. ``str`` uses the ``DI_FAULTS`` syntax; a dict
+    maps site -> N (first N calls) or site -> iterable of call numbers;
+    ``None`` re-arms lazy loading from the environment."""
+    global _plan
+    with _lock:
+        _counts.clear()
+        if plan is None:
+            _plan = None
+            return
+        if isinstance(plan, str):
+            _plan = _parse(plan)
+            return
+        parsed: Dict[str, Set[int]] = {}
+        for site, val in plan.items():
+            if isinstance(val, int):
+                parsed[site] = set(range(1, val + 1))
+            else:
+                parsed[site] = {int(v) for v in val}
+        _plan = parsed
+
+
+def reset() -> None:
+    """Clear the plan and all call counters (test teardown)."""
+    global _plan
+    with _lock:
+        _plan = {}
+        _counts.clear()
+
+
+def _active_plan() -> Dict[str, Set[int]]:
+    global _plan
+    if _plan is None:
+        with _lock:
+            if _plan is None:
+                try:
+                    _plan = _parse(os.environ.get("DI_FAULTS", ""))
+                except ValueError as exc:
+                    # The lazy env parse runs inside production probe
+                    # sites (loader batches, downloads) whose error
+                    # handling must see DATA failures, not a config typo
+                    # — e.g. the loader's skip budget would misclassify
+                    # this as a corrupt batch and silently eat the
+                    # budget. Explicit configure() calls still raise.
+                    import logging
+
+                    logging.getLogger(__name__).error(
+                        "ignoring malformed DI_FAULTS=%r: %s",
+                        os.environ.get("DI_FAULTS"), exc)
+                    _plan = {}
+    return _plan
+
+
+def fire(site: str) -> bool:
+    """Count a call at ``site``; True iff this call is in the plan."""
+    plan = _active_plan()
+    if not plan:
+        return False
+    with _lock:
+        if site not in plan:
+            return False
+        _counts[site] = _counts.get(site, 0) + 1
+        return _counts[site] in plan[site]
+
+
+def call_count(site: str) -> int:
+    with _lock:
+        return _counts.get(site, 0)
+
+
+def maybe_raise(site: str, make_exc) -> None:
+    """Raise ``make_exc()`` if ``site`` faults on this call."""
+    if fire(site):
+        raise make_exc()
+
+
+def poison_nan(batch):
+    """Every float leaf of the pytree replaced with NaN (host-side numpy)
+    — the canonical bad-batch injection for the non-finite guard."""
+    import jax
+    import numpy as np
+
+    def poison(leaf):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return leaf
+
+    return jax.tree_util.tree_map(poison, batch)
+
+
+def maybe_poison(site: str, batch):
+    return poison_nan(batch) if fire(site) else batch

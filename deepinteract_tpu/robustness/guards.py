@@ -1,0 +1,124 @@
+"""On-device non-finite step guard.
+
+One NaN loss or gradient silently poisons every parameter on the next
+optimizer update, and the run limps along producing garbage until a human
+notices. The guard makes the failure mode explicit and recoverable:
+
+* :func:`step_is_finite` — a single fused reduction (``isfinite(loss) &
+  isfinite(global_norm(grads))``) that is true iff the step is safe to
+  apply. It runs on device inside the jitted step; no host sync.
+* :func:`apply_guarded_update` — ``lax.cond`` between the normal
+  ``apply_gradients`` and a skip that leaves params/opt-state/step/
+  batch-stats untouched and increments ``TrainState.bad_steps`` (the
+  consecutive-skip counter; any good step resets it to zero).
+* The Trainer reads the counter from the step metrics and aborts with a
+  diagnostic dump (:func:`dump_diagnostics`) once it reaches
+  ``LoopConfig.max_bad_steps`` — a stream of consecutive non-finite steps
+  means the run is unrecoverable (bad data shard, diverged optimizer),
+  not transient.
+
+Multi-host agreement: the guard decision is computed from the
+psum/pmean-averaged loss and gradients (or their GSPMD-replicated
+equivalents), which are bitwise identical on every host — so every host
+takes the same ``lax.cond`` branch and the same abort decision by
+construction. The Trainer additionally cross-checks the counter with
+``parallel.multihost.assert_same_across_hosts`` before aborting, because
+a divergent abort would strand the surviving hosts in a collective.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class NonFiniteTrainingError(RuntimeError):
+    """Raised by the Trainer after ``max_bad_steps`` consecutive skipped
+    (non-finite) optimizer steps. Carries the diagnostics file path."""
+
+    def __init__(self, message: str, diagnostics_path: str | None = None):
+        super().__init__(message)
+        self.diagnostics_path = diagnostics_path
+
+
+def step_is_finite(loss: jnp.ndarray, grads: Any) -> jnp.ndarray:
+    """Scalar bool: True iff ``loss`` and every gradient entry are finite.
+
+    ``global_norm`` folds the whole gradient tree into one scalar whose
+    finiteness is equivalent to all-entries-finite (any NaN/inf propagates
+    through the sum of squares), so the check costs one reduction instead
+    of a per-leaf ``jnp.isfinite().all()`` sweep.
+    """
+    return jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+
+
+def apply_guarded_update(state, grads, loss, batch_stats) -> Tuple[Any, jnp.ndarray]:
+    """Apply the optimizer update only when the step is finite.
+
+    Returns ``(new_state, finite)``. On a bad step the state is unchanged
+    except ``bad_steps + 1`` — params, opt_state, the step counter, the
+    dropout rng fold, and batch statistics (which a NaN batch may also
+    have poisoned) all stay at their pre-step values. A good step resets
+    ``bad_steps`` to zero. Both branches live under ``lax.cond``: the
+    decision stays on device and costs no host round trip.
+    """
+    if state.bad_steps is None:
+        raise ValueError(
+            "guarded update needs TrainState.bad_steps initialized; build "
+            "the state via create_train_state (or pass bad_steps=0)"
+        )
+    finite = step_is_finite(loss, grads)
+
+    def update(_):
+        new = state.apply_gradients(grads=grads, batch_stats=batch_stats)
+        return new.replace(bad_steps=jnp.zeros_like(state.bad_steps))
+
+    def skip(_):
+        return state.replace(bad_steps=state.bad_steps + 1)
+
+    return jax.lax.cond(finite, update, skip, None), finite
+
+
+def summarize_batch(batch) -> Dict[str, Any]:
+    """Host-side summary of a (host numpy) batch pytree for the diagnostic
+    dump: per-leaf shape/dtype plus NaN/inf counts for float leaves and the
+    contact-target density — enough to identify a poisoned shard without
+    shipping the full arrays."""
+    leaves_info = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(batch)[0]:
+        arr = np.asarray(leaf)
+        info: Dict[str, Any] = {
+            "path": jax.tree_util.keystr(path),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+        if np.issubdtype(arr.dtype, np.floating):
+            info["nan_count"] = int(np.isnan(arr).sum())
+            info["inf_count"] = int(np.isinf(arr).sum())
+        elif np.issubdtype(arr.dtype, np.integer):
+            info["sum"] = int(arr.sum())
+        leaves_info.append(info)
+    return {"leaves": leaves_info}
+
+
+def dump_diagnostics(directory: str, payload: Dict[str, Any]) -> str:
+    """Write an abort-diagnostics JSON (atomic tmp+rename) and return its
+    path. Non-finite floats survive the round trip (json's Infinity/NaN
+    literals) — they are the whole point of the dump."""
+    os.makedirs(directory or ".", exist_ok=True)
+    path = os.path.join(
+        directory or ".",
+        f"nonfinite_abort_epoch{payload.get('epoch', 'x')}"
+        f"_step{payload.get('step', 'x')}.json",
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+    os.replace(tmp, path)
+    return path

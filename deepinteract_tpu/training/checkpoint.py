@@ -42,9 +42,12 @@ class Checkpointer:
 
         def best_fn(metrics):
             v = metrics.get(cfg.metric_to_track, math.nan)
-            # NaN/missing must rank worst AFTER the sign flip, else a NaN
-            # val_ce would score +inf under best_mode='max'.
-            return sign * v if not math.isnan(v) else -math.inf
+            # Non-finite/missing must rank worst AFTER the sign flip: a NaN
+            # val_ce would score +inf under best_mode='max', and a +inf
+            # val_auroc (mode 'max') would latch as an unbeatable best. An
+            # epoch whose tracked metric is not a finite number is never
+            # "best" — explicit policy, unit-tested in the chaos suite.
+            return sign * v if math.isfinite(v) else -math.inf
 
         # Multi-host runs checkpoint from the primary host only (the state
         # tree is replicated and already materialized as host-local numpy,

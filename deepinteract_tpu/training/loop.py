@@ -21,8 +21,11 @@ batch must already be padded/bucketed (see ``data.loader``).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import json
 import math
+import os
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
 
@@ -32,7 +35,18 @@ import numpy as np
 
 from deepinteract_tpu.data.graph import PairedComplex
 from deepinteract_tpu.models.model import DeepInteract
-from deepinteract_tpu.parallel.multihost import host_local_array, is_primary_host
+from deepinteract_tpu.parallel.multihost import (
+    assert_same_across_hosts,
+    host_local_array,
+    is_primary_host,
+)
+from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.robustness.guards import (
+    NonFiniteTrainingError,
+    dump_diagnostics,
+    summarize_batch,
+)
+from deepinteract_tpu.robustness.preemption import PreemptionGuard, TrainingPreempted
 from deepinteract_tpu.training import metrics as M
 from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig, metric_mode
 from deepinteract_tpu.training.optim import OptimConfig
@@ -75,6 +89,25 @@ class LoopConfig:
     # (consecutive same-shape val batches). At batch 1 the host round-trip
     # dominates a DIPS-scale val epoch (3,548 complexes); 1 disables.
     eval_batches_per_dispatch: int = 8
+    # Non-finite step guard (robustness/guards.py): steps whose loss or
+    # gradients are not finite skip the optimizer update on device
+    # (lax.cond, no host sync) instead of poisoning the weights; the
+    # consecutive-skip counter rides the TrainState and the step metrics.
+    # Finite steps compute identical math, so this is safe to leave on.
+    nonfinite_guard: bool = True
+    # Abort the run (NonFiniteTrainingError + diagnostic dump) once this
+    # many CONSECUTIVE steps were skipped — a sustained stream of bad
+    # steps means diverged optimization or a corrupt shard, not noise.
+    max_bad_steps: int = 10
+    # Install SIGTERM/SIGINT handlers around fit (robustness/
+    # preemption.py): on preemption the loop stops at the next dispatch
+    # boundary, drains the last/ checkpoint, and raises
+    # TrainingPreempted; rerunning with resume=True reproduces the
+    # uninterrupted run (epoch-boundary checkpoint granularity).
+    preemption_guard: bool = True
+    # Where non-finite abort diagnostics are written (None: ckpt_dir,
+    # falling back to the working directory).
+    diagnostics_dir: Optional[str] = None
     # Overlap the per-epoch checkpoint save with the next epoch's
     # training: the state is snapshotted on-device (one HBM copy, safe
     # under donated mesh steps) and a single worker thread fetches + runs
@@ -99,8 +132,13 @@ class EarlyStopping:
 
     def update(self, value: float) -> bool:
         """Returns True if training should stop (Lightning: stop once
-        ``wait_count >= patience``)."""
-        if math.isnan(value):
+        ``wait_count >= patience``).
+
+        Non-finite metrics are explicit, not incidental: NaN *and* ±inf
+        count against patience and never improve ``best`` — without the
+        guard a -inf val_ce (mode 'min') would latch as an unbeatable
+        best and disable early stopping for the rest of the run."""
+        if not math.isfinite(value):
             self.stale_epochs += 1
             return self.stale_epochs >= self.patience
         improved = (
@@ -168,6 +206,11 @@ class Trainer:
         self.mesh = mesh
         self.log = log_fn
         self.metric_writer = metric_writer
+        # Active PreemptionGuard while fit() runs (robustness/preemption
+        # .py); _run_train_epoch and evaluate poll it at dispatch
+        # boundaries. None outside fit or when preemption_guard is off.
+        self._preempt: Optional[PreemptionGuard] = None
+        guard = loop_cfg.nonfinite_guard
         from deepinteract_tpu.training.steps import multi_eval_step, multi_train_step
 
         if mesh is not None:
@@ -185,10 +228,12 @@ class Trainer:
             # comparing against a kept reference) builds its own step with
             # donate=False.
             self._train_step = make_sharded_train_step(
-                mesh, weight_classes=loop_cfg.weight_classes, donate=True
+                mesh, weight_classes=loop_cfg.weight_classes, donate=True,
+                guard=guard,
             )
             self._multi_step = make_sharded_multi_step(
-                mesh, weight_classes=loop_cfg.weight_classes, donate=True
+                mesh, weight_classes=loop_cfg.weight_classes, donate=True,
+                guard=guard,
             )
             self._eval_step = make_sharded_eval_step(mesh, weight_classes=loop_cfg.weight_classes)
             self._multi_eval = make_sharded_multi_eval_step(
@@ -196,7 +241,8 @@ class Trainer:
             )
         else:
             self._train_step = jax.jit(
-                lambda s, b: train_step(s, b, weight_classes=loop_cfg.weight_classes)
+                lambda s, b: train_step(s, b, weight_classes=loop_cfg.weight_classes,
+                                        guard=guard)
             )
             # Single-device multi-step/eval dispatches take the PACKED
             # upload: the stacked batch arrives as one buffer per dtype
@@ -210,7 +256,7 @@ class Trainer:
             self._multi_step_packed = jax.jit(
                 lambda s, bufs, spec: multi_train_step(
                     s, unpack_tree(bufs, spec),
-                    weight_classes=loop_cfg.weight_classes),
+                    weight_classes=loop_cfg.weight_classes, guard=guard),
                 static_argnums=2,
             )
             self._eval_step = jax.jit(
@@ -222,6 +268,31 @@ class Trainer:
                     weight_classes=loop_cfg.weight_classes),
                 static_argnums=2,
             )
+
+    def _check_preempt(self, epoch_boundary: bool = False) -> None:
+        """Cooperative preemption poll.
+
+        Single-process: every dispatch boundary. Multi-host: ONLY at epoch
+        boundaries, through an all-gather of the local flag, so every host
+        sees the same answer and raises together — a host-local raise
+        (signals rarely reach all hosts, and never simultaneously) would
+        strand the peers in the next collective. Same host-agreement
+        discipline as the non-finite abort."""
+        if self._preempt is None:
+            return
+        if jax.process_count() <= 1:
+            self._preempt.check()
+            return
+        if not epoch_boundary:
+            return
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self._preempt.requested))
+        if bool(np.any(flags)):
+            if not self._preempt.requested:
+                self._preempt.request("preemption requested on a peer host")
+            self._preempt.check()
 
     # -- state construction ------------------------------------------------
 
@@ -316,8 +387,6 @@ class Trainer:
             if first_checked or jax.process_count() <= 1:
                 return
             first_checked = True
-            from jax.experimental import multihost_utils
-
             cm = np.asarray(host_batch.contact_map)
             # Include the host's total val-batch count when the source
             # exposes it (ADVICE r4 item 3): hosts with identical first
@@ -333,14 +402,13 @@ class Trainer:
                                   else len(val_data))  # type: ignore[arg-type]
             except TypeError:
                 n_batches = -1.0  # unsized source; first-batch check only
-            fingerprint = np.asarray(
-                [float(np.asarray(host_batch.graph1.num_nodes).sum()),
-                 float(np.asarray(host_batch.graph2.num_nodes).sum()),
-                 float(cm.shape[0]), float(cm.shape[1]), float(cm.shape[2]),
-                 float(cm.sum()), n_batches],
-                dtype=np.float32,
-            )
-            multihost_utils.assert_equal(
+            fingerprint = [
+                float(np.asarray(host_batch.graph1.num_nodes).sum()),
+                float(np.asarray(host_batch.graph2.num_nodes).sum()),
+                float(cm.shape[0]), float(cm.shape[1]), float(cm.shape[2]),
+                float(cm.sum()), n_batches,
+            ]
+            assert_same_across_hosts(
                 fingerprint,
                 fail_message=(
                     "evaluate: hosts fed different first val batches or "
@@ -351,6 +419,7 @@ class Trainer:
 
         k = max(1, self.cfg.eval_batches_per_dispatch)
         for run in _shape_runs(_iter_data(val_data, 0), k):
+            self._check_preempt()
             if run:
                 check_host_agreement(run[0])
             if len(run) < max(k, 2):
@@ -406,33 +475,48 @@ class Trainer:
             )
         ) if (cfg.ckpt_dir and is_primary_host()) else None
 
+        stopper = EarlyStopping(
+            metric_mode(cfg.metric_to_track), cfg.patience, cfg.min_delta
+        )
         start_epoch = 0
         if resume:
             if ckpt is not None and ckpt.latest_step() is not None:
                 state = _restore_into(
                     state, ckpt.restore(state_template(state), which="last"))
                 start_epoch = int(ckpt.latest_step())
+                # EarlyStopping bookkeeping rides a JSON sidecar next to
+                # the orbax roots: a preemption-resume must not reset
+                # patience/best, or the resumed run would stop later than
+                # the uninterrupted one. The orbax step counter stays the
+                # source of truth — a sidecar whose epoch disagrees (crash
+                # between save and sidecar write) is ignored.
+                sidecar = _read_sidecar(cfg.ckpt_dir)
+                if sidecar and int(sidecar.get("epoch", -1)) == start_epoch:
+                    stopper.best = float(sidecar["stopper_best"])
+                    stopper.stale_epochs = int(sidecar["stopper_stale"])
                 self.log(f"resumed from epoch {start_epoch}")
             if jax.process_count() > 1:
                 # Only the primary host holds the Checkpointer; every other
-                # host must receive the restored state and epoch, or the
-                # hosts would train different weights over different epoch
-                # ranges (split-brain + collective deadlock at the end).
-                # The epoch goes first on its own: a fresh start (no
+                # host must receive the restored state, epoch, and stopper
+                # bookkeeping, or the hosts would train different weights
+                # over different epoch ranges / disagree on the early-stop
+                # epoch (split-brain + collective deadlock at the end).
+                # The scalars go first on their own: a fresh start (no
                 # checkpoint) then skips broadcasting the full state tree.
                 from jax.experimental import multihost_utils
 
-                start_epoch = int(multihost_utils.broadcast_one_to_all(
-                    np.asarray(start_epoch)))
+                vec = multihost_utils.broadcast_one_to_all(np.asarray(
+                    [float(start_epoch), stopper.best,
+                     float(stopper.stale_epochs)], dtype=np.float64))
+                start_epoch = int(vec[0])
+                stopper.best = float(vec[1])
+                stopper.stale_epochs = int(vec[2])
                 if start_epoch > 0:
                     tree = multihost_utils.broadcast_one_to_all(
                         state_to_tree(state))
                     state = _restore_into(
                         state, jax.tree_util.tree_map(np.asarray, tree))
 
-        stopper = EarlyStopping(
-            metric_mode(cfg.metric_to_track), cfg.patience, cfg.min_delta
-        )
         history: List[Dict[str, float]] = []
         epochs = num_epochs if num_epochs is not None else cfg.num_epochs
         t_start = time.time()
@@ -476,22 +560,45 @@ class Trainer:
                 lambda tr=tree, sn=step_no, me=dict(metrics):
                     ckpt.save(sn, _fetch_tree(tr), me))
 
+        # Cooperative preemption (robustness/preemption.py): entered
+        # manually (not `with`) to keep the epoch loop's indentation; the
+        # finally below always restores the previous signal handlers.
+        preempt = PreemptionGuard(log=self.log) if cfg.preemption_guard else None
+        self._preempt = preempt
+        if preempt is not None:
+            preempt.__enter__()
+        abort_exc = None
         try:
           for epoch in range(start_epoch, epochs):
+            self._check_preempt(epoch_boundary=True)
             t_epoch = time.time()
             train_losses = []
-            state = self._run_train_epoch(state, train_data, epoch, train_losses)
+            epoch_stats: Dict[str, float] = {}
+            state = self._run_train_epoch(state, train_data, epoch,
+                                          train_losses, epoch_stats)
             t_train_done = time.time()
+            if cfg.nonfinite_guard:
+                # Guarded epochs: skipped (non-finite) steps contributed
+                # no update — exclude their NaN losses from the epoch mean
+                # instead of letting one bad batch blank the whole metric.
+                finite = [float(l) for l in train_losses
+                          if math.isfinite(float(l))]
+                train_loss = float(np.mean(finite)) if finite else float("nan")
+            else:
+                train_loss = (float(np.mean([float(l) for l in train_losses]))
+                              if train_losses else float("nan"))
             epoch_metrics: Dict[str, float] = {
                 "epoch": epoch,
-                "train_loss": float(np.mean([float(l) for l in train_losses]))
-                if train_losses else float("nan"),
+                "train_loss": train_loss,
                 # Per-phase wall split for attributing sustained-
                 # throughput overhead (the remainder between epoch
                 # boundaries — checkpoint save, SWA snapshot, viz — is
                 # epoch-over-epoch wall minus these phases).
                 "train_seconds": t_train_done - t_epoch,
             }
+            if cfg.nonfinite_guard:
+                epoch_metrics["train_skipped_steps"] = float(
+                    epoch_stats.get("skipped_steps", 0))
             if val_data is not None:
                 epoch_metrics.update(self.evaluate(state, val_data, stage="val"))
                 epoch_metrics["val_eval_seconds"] = time.time() - t_train_done
@@ -546,12 +653,24 @@ class Trainer:
                     f"in {cfg.patience} epochs (best {stopper.best:.6f})"
                 )
                 stop = True
+            if ckpt is not None:
+                # After stopper.update so a resume restores the counters
+                # as of this epoch boundary (see the resume block above).
+                _write_sidecar(cfg.ckpt_dir, {
+                    "epoch": epoch + 1,
+                    "stopper_best": stopper.best,
+                    "stopper_stale": stopper.stale_epochs,
+                })
             if cfg.max_time_seconds and (time.time() - t_start) > cfg.max_time_seconds:
                 self.log("max_time reached; stopping")
                 stop = True
             if stop:
                 break
 
+        except (TrainingPreempted, NonFiniteTrainingError) as exc:
+            # Re-raised AFTER the drain below so the in-flight save (the
+            # checkpoint a preempted run resumes from) hits disk first.
+            abort_exc = exc
         finally:
             # Drain the in-flight save even when the loop raises: its
             # failure must not be swallowed, and the executor must not
@@ -564,6 +683,20 @@ class Trainer:
             finally:
                 if saver is not None:
                     saver.shutdown(wait=True)
+                if preempt is not None:
+                    preempt.__exit__(None, None, None)
+                self._preempt = None
+
+        if abort_exc is not None:
+            if ckpt is not None:
+                ckpt.close()
+            if isinstance(abort_exc, TrainingPreempted):
+                self.log(
+                    f"preempted ({abort_exc}): last/ checkpoint flushed at "
+                    f"the last completed epoch — rerun with resume=True to "
+                    "continue"
+                )
+            raise abort_exc
 
         if cfg.swa and swa_params is not None:
             self.log(f"SWA: averaged {swa_count} epoch snapshot(s) into final params")
@@ -594,26 +727,94 @@ class Trainer:
     # -- internals ---------------------------------------------------------
 
     def _run_train_epoch(self, state: TrainState, train_data: DataSource,
-                         epoch: int, train_losses: list) -> TrainState:
+                         epoch: int, train_losses: list,
+                         epoch_stats: Optional[Dict[str, float]] = None) -> TrainState:
         """One epoch of train steps, grouping consecutive same-shape batches
-        into K-step scanned dispatches (LoopConfig.steps_per_dispatch)."""
+        into K-step scanned dispatches (LoopConfig.steps_per_dispatch).
+
+        Robustness duties (all off the hot path):
+        * polls the PreemptionGuard between dispatches;
+        * applies the ``train.nan_batch`` / ``train.sigterm`` fault-
+          injection probes per batch (no-ops without a fault plan);
+        * tracks the guarded step's skip counters and aborts with a
+          diagnostic dump once ``max_bad_steps`` CONSECUTIVE steps were
+          skipped. With scanned dispatch + double-buffered metric fetch
+          the abort lands up to one dispatch late — acceptable, since the
+          guard already prevented every bad update on device.
+        """
         from deepinteract_tpu.training.steps import stack_microbatches
 
         cfg = self.cfg
         k = max(1, cfg.steps_per_dispatch)
         step_idx = 0
+        stats = epoch_stats if epoch_stats is not None else {}
+        stats.setdefault("skipped_steps", 0)
+        # Abort-diagnostics context: a short host-side metric history plus
+        # the last two dispatched runs' host batches (summarized lazily —
+        # only on abort — so steady state pays just two references).
+        recent_metrics: collections.deque = collections.deque(maxlen=32)
+        recent_runs: collections.deque = collections.deque(maxlen=2)
+
+        def abort_nonfinite(consecutive: int):
+            # Host agreement is BY CONSTRUCTION, not by collective: the
+            # guard branches on the pmean/GSPMD-replicated loss and grad
+            # norm, and the bad_steps counter lives in the replicated
+            # TrainState, so every host reads the same value and reaches
+            # this abort at the same step. No cross-host check belongs
+            # here — a collective on an abort path only the aborting
+            # host(s) execute would itself deadlock the survivors.
+            payload = {
+                "epoch": epoch,
+                "step": step_idx,
+                "consecutive_bad_steps": consecutive,
+                "max_bad_steps": cfg.max_bad_steps,
+                "recent_metrics": [
+                    {"loss": l, "grad_norm": g} for l, g in recent_metrics
+                ],
+                "recent_batches": [
+                    summarize_batch(b) for run in recent_runs for b in run
+                ],
+            }
+            path = None
+            if is_primary_host():
+                path = dump_diagnostics(
+                    cfg.diagnostics_dir or cfg.ckpt_dir or ".", payload)
+            raise NonFiniteTrainingError(
+                f"aborting: {consecutive} consecutive non-finite train steps "
+                f"(epoch {epoch}, step {step_idx}, max_bad_steps="
+                f"{cfg.max_bad_steps})"
+                + (f"; diagnostics: {path}" if path else ""),
+                diagnostics_path=path,
+            )
 
         def log_step(metrics):
             nonlocal step_idx
             step_idx += 1
             # host_local_array: multi-host losses are replicated global
             # arrays that plain float() cannot read.
-            train_losses.append(float(host_local_array(metrics["loss"])))
+            loss = float(host_local_array(metrics["loss"]))
+            train_losses.append(loss)
+            grad_norm = float(host_local_array(metrics["grad_norm"]))
+            recent_metrics.append((loss, grad_norm))
+            if "bad_step" in metrics:
+                if float(host_local_array(metrics["bad_step"])) > 0:
+                    stats["skipped_steps"] += 1
+                    self.log(
+                        f"epoch {epoch} step {step_idx}: non-finite "
+                        f"loss/grads (loss={loss}) — optimizer update "
+                        f"skipped ({stats['skipped_steps']} this epoch)"
+                    )
+                consecutive = int(float(host_local_array(metrics["bad_steps"])))
+                # `consecutive > 0`: a healthy step resets the counter to
+                # 0, which must never trip the abort even under a
+                # (nonsensical but accepted) max_bad_steps <= 0.
+                if consecutive > 0 and consecutive >= cfg.max_bad_steps:
+                    abort_nonfinite(consecutive)
             if cfg.log_every and step_idx % cfg.log_every == 0:
                 self.log(
                     f"epoch {epoch} step {step_idx}: "
                     f"loss={train_losses[-1]:.4f} "
-                    f"grad_norm={float(host_local_array(metrics['grad_norm'])):.4f}"
+                    f"grad_norm={grad_norm:.4f}"
                 )
 
         # Double-buffered metric fetch (VERDICT r4 item 3): the host fetch
@@ -640,7 +841,19 @@ class Trainer:
             for j in range(n):
                 log_step({k: v[j] for k, v in stacked_host.items()})
 
-        for run in _shape_runs(_iter_data(train_data, epoch), k):
+        def instrumented(items):
+            """Per-batch fault probes (robustness/faults.py): free when no
+            plan is configured. The sigterm probe only *requests*
+            preemption — the raise happens at the next dispatch boundary,
+            exactly like a real signal."""
+            for b in items:
+                if faults.fire("train.sigterm") and self._preempt is not None:
+                    self._preempt.request("injected SIGTERM (fault plan)")
+                yield faults.maybe_poison("train.nan_batch", b)
+
+        for run in _shape_runs(instrumented(_iter_data(train_data, epoch)), k):
+            self._check_preempt()
+            recent_runs.append(run)
             if len(run) < max(k, 2):
                 if pending is not None:
                     flush(pending)
@@ -843,6 +1056,30 @@ def state_template(state: TrainState):
         return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
 
     return jax.tree_util.tree_map(absify, _state_dict(state))
+
+
+def _sidecar_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, "trainer_state.json")
+
+
+def _write_sidecar(ckpt_dir: str, payload: Dict[str, Any]) -> None:
+    """Persist loop-level bookkeeping (EarlyStopping best/patience) that
+    lives outside the TrainState pytree — atomic tmp+rename so a
+    preemption mid-write leaves the previous epoch's sidecar intact.
+    ``json`` round-trips ±inf (the fresh-stopper ``best``) natively."""
+    path = _sidecar_path(ckpt_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _read_sidecar(ckpt_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_sidecar_path(ckpt_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def _restore_into(state: TrainState, restored) -> TrainState:

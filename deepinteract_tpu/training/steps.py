@@ -26,6 +26,12 @@ from deepinteract_tpu.training.optim import OptimConfig, make_optimizer
 class TrainState(train_state.TrainState):
     batch_stats: Any = None
     dropout_rng: jax.Array = None
+    # Consecutive non-finite (skipped) optimizer steps — maintained on
+    # device by the guarded step (robustness/guards.py); None when the
+    # guard is unused. Deliberately transient: it is NOT part of the
+    # checkpoint payload (training/loop.py _state_dict), so resume resets
+    # it to zero and old checkpoints stay restorable.
+    bad_steps: Any = None
 
 
 def create_train_state(
@@ -57,6 +63,7 @@ def create_train_state(
         tx=make_optimizer(optim_cfg, frozen_prefixes=frozen_prefixes),
         batch_stats=variables.get("batch_stats", {}),
         dropout_rng=dropout_rng,
+        bad_steps=jnp.zeros((), jnp.int32),
     )
 
 
@@ -79,21 +86,35 @@ def train_step(
     batch: PairedComplex,
     weight_classes: bool = False,
     axis_name: Optional[str] = None,
+    guard: bool = False,
 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """One optimization step. When ``axis_name`` is set (inside pmap /
     shard_map), gradients and metrics are psum-averaged across the data axis
     — the XLA-collective equivalent of DDP's gradient all-reduce
-    (SURVEY.md §2.6)."""
+    (SURVEY.md §2.6).
+
+    With ``guard=True`` the update is applied only when loss and gradients
+    are finite (robustness/guards.py): bad steps leave the state untouched
+    except for the on-device consecutive-skip counter, and the metrics gain
+    ``bad_step`` (this step skipped, 0/1) and ``bad_steps`` (consecutive
+    skips after this step). The guard decision is computed AFTER the
+    cross-host gradient average, so every host branches identically."""
     dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
     grad_fn = jax.value_and_grad(loss_and_updates, has_aux=True)
     (loss, mutated), grads = grad_fn(state.params, state, batch, weight_classes, dropout_rng)
     if axis_name is not None:
         grads = jax.lax.pmean(grads, axis_name)
         loss = jax.lax.pmean(loss, axis_name)
-    new_state = state.apply_gradients(
-        grads=grads, batch_stats=mutated.get("batch_stats", state.batch_stats)
-    )
+    batch_stats = mutated.get("batch_stats", state.batch_stats)
     metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+    if guard:
+        from deepinteract_tpu.robustness.guards import apply_guarded_update
+
+        new_state, finite = apply_guarded_update(state, grads, loss, batch_stats)
+        metrics["bad_step"] = 1.0 - finite.astype(jnp.float32)
+        metrics["bad_steps"] = new_state.bad_steps.astype(jnp.float32)
+    else:
+        new_state = state.apply_gradients(grads=grads, batch_stats=batch_stats)
     return new_state, metrics
 
 
@@ -102,6 +123,7 @@ def multi_train_step(
     batches: PairedComplex,
     weight_classes: bool = False,
     axis_name: Optional[str] = None,
+    guard: bool = False,
 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """K optimization steps in ONE dispatch: ``lax.scan`` over batches
     stacked on a leading axis ([K, B, ...] per leaf).
@@ -118,7 +140,8 @@ def multi_train_step(
     """
 
     def body(s, b):
-        s, m = train_step(s, b, weight_classes=weight_classes, axis_name=axis_name)
+        s, m = train_step(s, b, weight_classes=weight_classes,
+                          axis_name=axis_name, guard=guard)
         return s, m
 
     return jax.lax.scan(body, state, batches)

@@ -1,0 +1,716 @@
+"""Chaos suite: deterministic fault injection across the robustness layer.
+
+Every test drives a REAL failure path end-to-end — NaN-poisoned batches
+through the guarded train step, simulated SIGTERM through the preemption
+guard + resume round trip, transient network/subprocess failures through
+the retry/backoff decorators — using the deterministic probes in
+``robustness/faults.py``. CPU-only and fast by construction (toy flax
+model, file:// downloads, zeroed retry delays), so the whole suite runs
+in the quick tier; select it alone with ``pytest -m chaos``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import random
+import stat
+import subprocess
+from urllib.error import URLError
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.robustness.guards import NonFiniteTrainingError, apply_guarded_update
+from deepinteract_tpu.robustness.preemption import PreemptionGuard, TrainingPreempted
+from deepinteract_tpu.robustness.retry import retry
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Each test starts with an empty fault plan and no retry env
+    overrides, and never leaks its plan into later tests."""
+    for var in ("DI_FAULTS", "DI_RETRY_MAX_ATTEMPTS", "DI_RETRY_BASE_DELAY",
+                "DI_RETRY_MAX_DELAY", "DI_RETRY_DEADLINE",
+                "DI_DOWNLOAD_TIMEOUT"):
+        monkeypatch.delenv(var, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def no_delays(monkeypatch):
+    """Zero every retry backoff via the env overrides (the same knobs an
+    operator would use), keeping chaos tests instant."""
+    monkeypatch.setenv("DI_RETRY_BASE_DELAY", "0")
+    monkeypatch.setenv("DI_RETRY_MAX_DELAY", "0")
+
+
+# ---------------------------------------------------------------------------
+# retry.py
+
+
+def test_retry_transient_then_success_backoff_sequence():
+    calls, sleeps = [], []
+
+    @retry(exceptions=(RuntimeError,), max_attempts=4, base_delay=1.0,
+           max_delay=8.0, sleep=sleeps.append, rng=random.Random(0))
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert len(calls) == 4 and len(sleeps) == 3
+    # Exponential envelope with full jitter: delay_i in [2^i / 2, 2^i].
+    for i, s in enumerate(sleeps):
+        assert 0.5 * (2 ** i) <= s <= (2 ** i), (i, s)
+
+
+def test_retry_exhaustion_reraises_original_error():
+    calls = []
+
+    @retry(exceptions=(RuntimeError,), max_attempts=3, base_delay=0.0,
+           sleep=lambda s: None)
+    def doomed():
+        calls.append(1)
+        raise RuntimeError("permanent-ish")
+
+    with pytest.raises(RuntimeError, match="permanent-ish"):
+        doomed()
+    assert len(calls) == 3
+
+
+def test_retry_nonretryable_predicate_fails_fast():
+    calls = []
+
+    @retry(exceptions=(ValueError,), max_attempts=5, base_delay=0.0,
+           retryable=lambda exc: "transient" in str(exc),
+           sleep=lambda s: None)
+    def picky():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError, match="permanent"):
+        picky()
+    assert len(calls) == 1
+
+
+def test_retry_deadline_stops_early():
+    t = {"now": 0.0}
+    calls = []
+
+    @retry(exceptions=(RuntimeError,), max_attempts=10, base_delay=10.0,
+           max_delay=10.0, deadline=12.0, sleep=lambda s: t.__setitem__("now", t["now"] + s),
+           clock=lambda: t["now"], rng=random.Random(0))
+    def slow_fail():
+        calls.append(1)
+        raise RuntimeError("x")
+
+    with pytest.raises(RuntimeError):
+        slow_fail()
+    # Far fewer than max_attempts: the deadline cut the loop.
+    assert len(calls) < 10
+
+
+def test_retry_env_overrides_max_attempts(monkeypatch):
+    monkeypatch.setenv("DI_RETRY_MAX_ATTEMPTS", "1")
+    calls = []
+
+    @retry(exceptions=(RuntimeError,), max_attempts=5, base_delay=0.0,
+           sleep=lambda s: None)
+    def fn():
+        calls.append(1)
+        raise RuntimeError("x")
+
+    with pytest.raises(RuntimeError):
+        fn()
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# faults.py
+
+
+def test_fault_plan_parsing_and_counters():
+    faults.configure("a.b=2;c.d=@3,5")
+    assert faults.fire("a.b") and faults.fire("a.b") and not faults.fire("a.b")
+    fired = [faults.fire("c.d") for _ in range(5)]
+    assert fired == [False, False, True, False, True]
+    assert faults.fire("unknown.site") is False
+    assert faults.call_count("a.b") == 3
+    faults.reset()
+    assert faults.fire("a.b") is False
+
+
+def test_poison_nan_hits_float_leaves_only():
+    tree = {"f": np.ones(3, np.float32), "i": np.arange(3, dtype=np.int32)}
+    poisoned = faults.poison_nan(tree)
+    assert np.isnan(poisoned["f"]).all()
+    np.testing.assert_array_equal(poisoned["i"], tree["i"])
+
+
+def test_robustness_package_does_not_import_jax():
+    """The probe/retry layer consumed by CPU-only featurization workers
+    (downloads, native compiles, HH-suite) must NOT drag jax/optax in
+    (multi-second startup + accelerator claiming): guards re-exports are
+    lazy. (`data/` itself pulls jax via its package __init__ — a
+    pre-existing, separate concern.)"""
+    code = (
+        "import sys; import deepinteract_tpu.robustness; "
+        "from deepinteract_tpu.robustness import faults, retry; "
+        "sys.exit(1 if ('jax' in sys.modules or 'optax' in sys.modules) "
+        "else 0)"
+    )
+    proc = subprocess.run([__import__("sys").executable, "-c", code],
+                          capture_output=True)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+def test_malformed_env_fault_plan_is_ignored_not_fatal(monkeypatch):
+    monkeypatch.setenv("DI_FAULTS", "loader.batch")  # missing '=N'
+    faults.configure(None)  # re-arm lazy env parsing
+    assert faults.fire("loader.batch") is False  # logged, not raised
+    with pytest.raises(ValueError, match="malformed fault spec"):
+        faults.configure("loader.batch")  # explicit calls still raise
+
+
+# ---------------------------------------------------------------------------
+# guards.py — unit level (toy TrainState, no model)
+
+
+def _toy_state():
+    from deepinteract_tpu.training.steps import TrainState
+
+    return TrainState.create(
+        apply_fn=None, params={"w": jnp.ones(3)}, tx=optax.sgd(0.1),
+        batch_stats={}, dropout_rng=jax.random.PRNGKey(0),
+        bad_steps=jnp.zeros((), jnp.int32),
+    )
+
+
+def test_guarded_update_skips_and_counts():
+    state = _toy_state()
+
+    @jax.jit
+    def step(s, grads, loss):
+        return apply_guarded_update(s, grads, loss, s.batch_stats)
+
+    good = {"w": jnp.full(3, 0.5)}
+    bad = {"w": jnp.array([0.5, np.nan, 0.5])}
+
+    s, finite = step(state, good, jnp.float32(1.0))
+    assert bool(finite) and int(s.step) == 1 and int(s.bad_steps) == 0
+    w_before = np.asarray(s.params["w"])
+
+    s, finite = step(s, bad, jnp.float32(1.0))  # NaN grads
+    assert not bool(finite)
+    np.testing.assert_array_equal(np.asarray(s.params["w"]), w_before)
+    assert int(s.step) == 1 and int(s.bad_steps) == 1
+
+    s, finite = step(s, good, jnp.float32(np.inf))  # inf loss
+    assert not bool(finite) and int(s.bad_steps) == 2
+
+    s, finite = step(s, good, jnp.float32(1.0))  # recovery resets
+    assert bool(finite) and int(s.step) == 2 and int(s.bad_steps) == 0
+
+
+# ---------------------------------------------------------------------------
+# preemption.py
+
+
+def test_preemption_guard_flag_and_check():
+    guard = PreemptionGuard(log=lambda s: None)
+    guard.check()  # no-op before request
+    guard.request("test")
+    assert guard.requested and guard.reason == "test"
+    with pytest.raises(TrainingPreempted, match="test"):
+        guard.check()
+
+
+def test_preemption_guard_catches_sigterm_and_restores_handler():
+    import signal
+
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(log=lambda s: None) as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested
+        assert "SIGTERM" in guard.reason
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+# ---------------------------------------------------------------------------
+# data/download.py
+
+
+def _file_url(path) -> str:
+    return "file://" + str(path)
+
+
+def test_download_happy_path_and_sha1(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload")
+    from deepinteract_tpu.data.download import download_and_verify, sha1_of
+
+    dest = tmp_path / "out" / "dest.bin"
+    got = download_and_verify(_file_url(src), str(dest), sha1=sha1_of(str(src)))
+    assert got == str(dest) and dest.read_bytes() == b"payload"
+
+
+def test_download_transient_failures_retried(tmp_path, no_delays):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload")
+    from deepinteract_tpu.data.download import download_and_verify
+
+    faults.configure({"download.fetch": 2})  # first two attempts fail
+    dest = tmp_path / "dest.bin"
+    download_and_verify(_file_url(src), str(dest))
+    assert dest.read_bytes() == b"payload"
+    assert faults.call_count("download.fetch") == 3
+
+
+def test_download_permanent_failure_reraises_original(tmp_path, no_delays):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload")
+    from deepinteract_tpu.data.download import download_and_verify
+
+    faults.configure({"download.fetch": 99})  # never succeeds
+    with pytest.raises(URLError, match="injected transient"):
+        download_and_verify(_file_url(src), str(tmp_path / "dest.bin"))
+    assert faults.call_count("download.fetch") == 4  # the attempt budget
+    assert not (tmp_path / "dest.bin").exists()
+
+
+def test_download_sha1_mismatch_hard_fails_without_retry(tmp_path, no_delays):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload")
+    from deepinteract_tpu.data.download import download_and_verify
+
+    faults.configure({"download.fetch": 0})  # count calls, never fault
+    with pytest.raises(ValueError, match="sha1 mismatch"):
+        download_and_verify(_file_url(src), str(tmp_path / "dest.bin"),
+                            sha1="0" * 40)
+    assert faults.call_count("download.fetch") == 1  # no retry on checksum
+
+
+def test_download_overwrite_refetches_corrupt_dest(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"fresh artifact")
+    from deepinteract_tpu.data.download import download_and_verify, sha1_of
+
+    good_sha = sha1_of(str(src))
+    dest = tmp_path / "dest.bin"
+    dest.write_bytes(b"corrupt old bytes")
+
+    with pytest.raises(ValueError, match="overwrite=True"):
+        download_and_verify(_file_url(src), str(dest), sha1=good_sha)
+    assert dest.read_bytes() == b"corrupt old bytes"  # untouched
+
+    download_and_verify(_file_url(src), str(dest), sha1=good_sha,
+                        overwrite=True)
+    assert dest.read_bytes() == b"fresh artifact"
+
+
+def test_download_passes_explicit_socket_timeout(tmp_path, monkeypatch):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload")
+    import urllib.request as ur
+
+    seen = {}
+    real = ur.urlopen
+
+    def spy(url, timeout=None):
+        seen["timeout"] = timeout
+        return real(url, timeout=timeout)
+
+    monkeypatch.setattr(ur, "urlopen", spy)
+    monkeypatch.setenv("DI_DOWNLOAD_TIMEOUT", "7.5")
+    from deepinteract_tpu.data.download import download_and_verify
+
+    download_and_verify(_file_url(src), str(tmp_path / "dest.bin"))
+    assert seen["timeout"] == 7.5
+
+
+# ---------------------------------------------------------------------------
+# pipeline/native.py
+
+
+def test_native_latch_reason_and_reset(tmp_path, monkeypatch):
+    from deepinteract_tpu.pipeline import native
+
+    native.reset()
+    monkeypatch.setattr(native, "_LIB_PATH", str(tmp_path / "nope.so"))
+    monkeypatch.setattr(native, "_BUILD_DIR", str(tmp_path))
+
+    def broken(cmd):
+        raise FileNotFoundError("g++ not found (injected)")
+
+    monkeypatch.setattr(native, "_run_compiler", broken)
+    try:
+        assert native.available() is False
+        reason = native.disabled_reason()
+        assert reason is not None and "g++ not found" in reason
+        # The latch holds without re-running the compiler...
+        assert native.available() is False
+        # ...until the documented escape hatch clears it.
+        native.reset()
+        assert native.disabled_reason() is None
+    finally:
+        native.reset()  # leave a clean slate for other tests
+
+
+@pytest.mark.skipif(
+    __import__("shutil").which(os.environ.get("CXX", "g++")) is None,
+    reason="no C++ compiler in environment",
+)
+def test_native_compile_retries_transient_failure(tmp_path, monkeypatch,
+                                                  no_delays):
+    from deepinteract_tpu.pipeline import native
+
+    native.reset()
+    monkeypatch.setattr(native, "_BUILD_DIR", str(tmp_path))
+    monkeypatch.setattr(native, "_LIB_PATH", str(tmp_path / "geomfeats.so"))
+    faults.configure({"native.compile": 1})  # first compiler call faults
+    try:
+        assert native.available() is True
+        assert faults.call_count("native.compile") == 2  # retried once
+    finally:
+        native.reset()
+
+
+# ---------------------------------------------------------------------------
+# HH-suite wrapper (pipeline/postprocess.py)
+
+
+@pytest.fixture()
+def fake_hhblits(tmp_path):
+    from test_hhblits import write_fixture
+
+    canned = tmp_path / "canned.hhm"
+    write_fixture(str(canned))
+    script = tmp_path / "hhblits"
+    script.write_text(
+        "#!/bin/sh\n"
+        'out=""\n'
+        'while [ $# -gt 0 ]; do\n'
+        '  if [ "$1" = "-ohhm" ]; then out="$2"; shift; fi\n'
+        "  shift\n"
+        "done\n"
+        f'cp "{canned}" "$out"\n'
+    )
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
+def test_hhblits_transient_failure_retried(fake_hhblits, no_delays):
+    from deepinteract_tpu import constants
+    from deepinteract_tpu.pipeline.postprocess import _run_hhblits
+
+    faults.configure({"hhblits.run": 1})  # first invocation faults
+    out = _run_hhblits("ACD", fake_hhblits, "/nonexistent/db")
+    assert out.shape == (3, constants.NUM_SEQUENCE_FEATS)
+    assert out[0, 0] == 1.0  # fixture row decoded -> the retry succeeded
+    assert faults.call_count("hhblits.run") == 2
+
+
+def test_hhblits_permanent_failure_exhausts_and_raises(fake_hhblits,
+                                                       no_delays):
+    from deepinteract_tpu.pipeline.postprocess import _run_hhblits
+
+    # The injected failure mimics an OOM kill (exit 137): transient class,
+    # so every attempt is consumed before the original error propagates.
+    faults.configure({"hhblits.run": 99})
+    with pytest.raises(subprocess.CalledProcessError):
+        _run_hhblits("ACD", fake_hhblits, "/nonexistent/db")
+    assert faults.call_count("hhblits.run") == 3  # the attempt budget
+
+
+def test_hhblits_deterministic_failure_fails_fast(tmp_path, no_delays):
+    """An hhblits that exits with an ordinary error code (bad database,
+    bad flags) is deterministic — one attempt, no backoff burned."""
+    from deepinteract_tpu.pipeline.postprocess import _run_hhblits
+
+    script = tmp_path / "hhblits"
+    script.write_text("#!/bin/sh\nexit 2\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    faults.configure({"hhblits.run": 0})  # count-only probe
+    with pytest.raises(subprocess.CalledProcessError):
+        _run_hhblits("ACD", str(script), "/nonexistent/db")
+    assert faults.call_count("hhblits.run") == 1
+
+
+# ---------------------------------------------------------------------------
+# data/loader.py skip budget
+
+
+def _tiny_dataset(n_complexes=4):
+    from test_data_layer import make_raw_complex
+
+    from deepinteract_tpu.data.loader import InMemoryDataset
+
+    rng = np.random.default_rng(3)
+    return InMemoryDataset(
+        [make_raw_complex(10, 8, rng) for _ in range(n_complexes)]
+    )
+
+
+def test_loader_skip_budget_drops_corrupt_batch_and_logs():
+    from deepinteract_tpu.data.loader import BucketedLoader
+
+    ds = _tiny_dataset(4)
+    faults.configure({"loader.batch": [2]})  # second batch is corrupt
+    loader = BucketedLoader(ds, batch_size=1, prefetch=0, skip_budget=1)
+    batches = list(loader.iter_epoch(0))
+    assert len(batches) == 3  # one skipped, epoch survived
+
+
+def test_loader_over_budget_reraises():
+    from deepinteract_tpu.data.loader import BucketedLoader
+
+    ds = _tiny_dataset(4)
+    faults.configure({"loader.batch": [1, 2]})
+    loader = BucketedLoader(ds, batch_size=1, prefetch=0, skip_budget=1)
+    with pytest.raises(ValueError, match="injected corrupt complex"):
+        list(loader.iter_epoch(0))
+
+
+def test_loader_skip_budget_zero_fails_fast():
+    from deepinteract_tpu.data.loader import BucketedLoader
+
+    ds = _tiny_dataset(2)
+    faults.configure({"loader.batch": [1]})
+    loader = BucketedLoader(ds, batch_size=1, prefetch=0)
+    with pytest.raises(ValueError, match="injected corrupt complex"):
+        list(loader.iter_epoch(0))
+
+
+def test_loader_skip_budget_rejects_multihost_sharding():
+    from deepinteract_tpu.data.loader import BucketedLoader
+
+    ds = _tiny_dataset(2)
+    with pytest.raises(ValueError, match="unsharded"):
+        BucketedLoader(ds, batch_size=1, shard=(0, 2), skip_budget=1)
+
+
+# ---------------------------------------------------------------------------
+# EarlyStopping / Checkpointer non-finite metric policy
+
+
+def test_early_stopping_nonfinite_counts_against_patience():
+    from deepinteract_tpu.training.loop import EarlyStopping
+
+    es = EarlyStopping(mode="min", patience=2, min_delta=0.0)
+    assert not es.update(1.0)
+    assert es.best == 1.0
+    assert not es.update(float("nan"))  # stale 1, best untouched
+    assert es.best == 1.0
+    assert es.update(float("-inf"))  # stale 2 -> stop; -inf never "improves"
+    assert es.best == 1.0
+
+    es_max = EarlyStopping(mode="max", patience=2, min_delta=0.0)
+    assert not es_max.update(0.5)
+    assert not es_max.update(float("inf"))  # +inf never improves in max mode
+    assert es_max.best == 0.5
+    assert es_max.update(float("nan"))
+
+
+def test_checkpointer_best_k_ignores_nonfinite_metrics(tmp_path):
+    from deepinteract_tpu.training.checkpoint import CheckpointConfig, Checkpointer
+
+    tree = {"w": np.zeros(3, np.float32)}
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path / "min"),
+                                         metric_to_track="val_ce",
+                                         save_top_k=2))
+    for step, ce in ((1, 0.5), (2, float("nan")), (3, float("-inf")), (4, 0.4)):
+        ckpt.save(step, tree, {"val_ce": ce})
+    ckpt.wait()
+    assert ckpt.best_step() == 4  # -inf val_ce must NOT rank best
+    ckpt.close()
+
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path / "max"),
+                                         metric_to_track="val_auroc",
+                                         save_top_k=2))
+    for step, auroc in ((1, 0.7), (2, float("inf")), (3, float("nan"))):
+        ckpt.save(step, tree, {"val_auroc": auroc})
+    ckpt.wait()
+    assert ckpt.best_step() == 1  # +inf val_auroc must NOT rank best
+    ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Trainer-level chaos: toy model kept tiny so these stay in the quick tier
+
+
+class ToyContactModel(nn.Module):
+    """Minimal model with the DeepInteract apply signature: logits
+    [B, N1, N2, 2] from a bilinear pairing of node features. Compiles in
+    well under a second on CPU — the point of the chaos suite is the
+    loop's failure handling, not the architecture."""
+
+    features: int = 4
+
+    @nn.compact
+    def __call__(self, g1, g2, train: bool = False):
+        h1 = nn.Dense(self.features)(g1.node_feats)
+        h2 = nn.Dense(self.features)(g2.node_feats)
+        pair = jnp.einsum("...if,...jf->...ij", h1, h2)
+        return jnp.stack([-pair, pair], axis=-1)
+
+
+@pytest.fixture(scope="module")
+def toy_data():
+    from deepinteract_tpu.data.graph import stack_complexes
+    from deepinteract_tpu.data.synthetic import random_complex
+
+    rng = np.random.default_rng(5)
+    return [
+        stack_complexes([random_complex(10, 8, rng=rng, n_pad1=16, n_pad2=16,
+                                        knn=4, geo_nbrhd_size=2)])
+        for _ in range(4)
+    ]
+
+
+def _toy_trainer(tmp_dir=None, **cfg_kwargs):
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    cfg_kwargs.setdefault("log_every", 0)
+    cfg_kwargs.setdefault("patience", 50)
+    cfg_kwargs.setdefault("eval_batches_per_dispatch", 1)
+    cfg = LoopConfig(ckpt_dir=tmp_dir, **cfg_kwargs)
+    optim = OptimConfig(lr=1e-2, steps_per_epoch=4, num_epochs=4)
+    return Trainer(ToyContactModel(), cfg, optim, log_fn=lambda s: None)
+
+
+def test_nan_batch_skipped_training_continues(toy_data):
+    faults.configure({"train.nan_batch": [2]})  # poison the 2nd batch
+    trainer = _toy_trainer(num_epochs=1)
+    state = trainer.init_state(toy_data[0])
+    state, history = trainer.fit(state, toy_data)
+    # 4 batches, one skipped: the optimizer advanced 3 steps and the skip
+    # is visible in the epoch metrics; the epoch mean stays finite.
+    assert int(state.step) == 3
+    assert int(state.bad_steps) == 0  # a good step followed the bad one
+    assert history[0]["train_skipped_steps"] == 1.0
+    assert math.isfinite(history[0]["train_loss"])
+
+
+def test_nan_batch_skipped_under_scanned_dispatch(toy_data):
+    faults.configure({"train.nan_batch": [3]})
+    trainer = _toy_trainer(num_epochs=1, steps_per_dispatch=2)
+    state = trainer.init_state(toy_data[0])
+    state, history = trainer.fit(state, toy_data)
+    assert int(state.step) == 3
+    assert history[0]["train_skipped_steps"] == 1.0
+
+
+def test_consecutive_nan_aborts_with_diagnostics(toy_data, tmp_path):
+    faults.configure({"train.nan_batch": 99})  # every batch poisoned
+    trainer = _toy_trainer(str(tmp_path / "ckpt"), num_epochs=2,
+                           max_bad_steps=3)
+    state = trainer.init_state(toy_data[0])
+    with pytest.raises(NonFiniteTrainingError) as exc_info:
+        trainer.fit(state, toy_data)
+    path = exc_info.value.diagnostics_path
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["consecutive_bad_steps"] >= 3
+    # The dump names the poison: NaN-saturated float leaves in the batch.
+    nan_counts = [leaf.get("nan_count", 0)
+                  for batch in payload["recent_batches"]
+                  for leaf in batch["leaves"]]
+    assert sum(nan_counts) > 0
+    assert len(payload["recent_metrics"]) >= 3
+
+
+def test_sigterm_flushes_checkpoint_and_resume_reproduces(toy_data, tmp_path):
+    from deepinteract_tpu.training.loop import _read_sidecar
+
+    # Reference: uninterrupted 3-epoch run.
+    dir_a = str(tmp_path / "a")
+    trainer_a = _toy_trainer(dir_a, num_epochs=3)
+    state_a = trainer_a.init_state(toy_data[0])
+    state_a, history_a = trainer_a.fit(state_a, toy_data,
+                                       val_data=toy_data[:1])
+
+    # Chaos run: SIGTERM injected at the 6th train batch (mid-epoch 1).
+    dir_b = str(tmp_path / "b")
+    faults.configure({"train.sigterm": [6]})
+    trainer_b = _toy_trainer(dir_b, num_epochs=3)
+    state_b = trainer_b.init_state(toy_data[0])
+    with pytest.raises(TrainingPreempted):
+        trainer_b.fit(state_b, toy_data, val_data=toy_data[:1])
+    # The last/ checkpoint of the completed epoch 0 is flushed to disk.
+    assert os.path.isdir(os.path.join(dir_b, "last"))
+    faults.reset()
+
+    # Resume: restores the epoch-0 boundary, re-runs epochs 1-2, and must
+    # reproduce the uninterrupted run bit-for-bit (deterministic loop).
+    trainer_b2 = _toy_trainer(dir_b, num_epochs=3)
+    state_b2 = trainer_b2.init_state(toy_data[0])
+    state_b2, history_b2 = trainer_b2.fit(state_b2, toy_data,
+                                          val_data=toy_data[:1], resume=True)
+    assert [h["epoch"] for h in history_b2] == [1, 2]
+    assert int(state_b2.step) == int(state_a.step) == 12
+    for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                    jax.tree_util.tree_leaves(state_b2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(history_b2[-1]["train_loss"],
+                               history_a[-1]["train_loss"], rtol=1e-6)
+    np.testing.assert_allclose(history_b2[-1]["val_ce"],
+                               history_a[-1]["val_ce"], rtol=1e-6)
+    # EarlyStopping/best bookkeeping round-tripped through the sidecar.
+    side_a, side_b = _read_sidecar(dir_a), _read_sidecar(dir_b)
+    assert side_a is not None and side_b is not None
+    assert side_a["epoch"] == side_b["epoch"] == 3
+    np.testing.assert_allclose(side_b["stopper_best"], side_a["stopper_best"])
+    assert side_b["stopper_stale"] == side_a["stopper_stale"]
+
+
+def test_resume_restores_optimizer_state_and_best_k(toy_data, tmp_path):
+    """Kill after a clean epoch-boundary checkpoint flush; the resumed
+    run's optimizer state and orbax best-k bookkeeping must match the
+    uninterrupted run's."""
+    from deepinteract_tpu.training.checkpoint import CheckpointConfig, Checkpointer
+
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    trainer_a = _toy_trainer(dir_a, num_epochs=3)
+    state_a = trainer_a.init_state(toy_data[0])
+    state_a, _ = trainer_a.fit(state_a, toy_data, val_data=toy_data[:1])
+
+    # Interrupt exactly at the start of epoch 2 (batch 9 of 4/epoch):
+    # epochs 0 and 1 are checkpointed, epoch 2 never starts.
+    faults.configure({"train.sigterm": [9]})
+    trainer_b = _toy_trainer(dir_b, num_epochs=3)
+    state_b = trainer_b.init_state(toy_data[0])
+    with pytest.raises(TrainingPreempted):
+        trainer_b.fit(state_b, toy_data, val_data=toy_data[:1])
+    faults.reset()
+
+    trainer_b2 = _toy_trainer(dir_b, num_epochs=3)
+    state_b2 = trainer_b2.init_state(toy_data[0])
+    state_b2, history_b2 = trainer_b2.fit(state_b2, toy_data,
+                                          val_data=toy_data[:1], resume=True)
+    assert [h["epoch"] for h in history_b2] == [2]
+    # Optimizer state (Adam moments) identical to the uninterrupted run.
+    for a, b in zip(jax.tree_util.tree_leaves(state_a.opt_state),
+                    jax.tree_util.tree_leaves(state_b2.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Orbax kept per-step metrics across the restart: best-k agrees.
+    ck_a = Checkpointer(CheckpointConfig(directory=dir_a))
+    ck_b = Checkpointer(CheckpointConfig(directory=dir_b))
+    assert ck_a.best_step() == ck_b.best_step()
+    assert ck_a.latest_step() == ck_b.latest_step() == 3
+    ck_a.close()
+    ck_b.close()
