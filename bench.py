@@ -1,11 +1,17 @@
 """Benchmark harness: flagship forward + full train step on the live backend.
 
-Contract (driver): prints exactly ONE JSON line on stdout —
+Contract (driver): prints the headline JSON record —
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}`` (plus
-compatibility keys; consumers read by name). All detail (per-bucket
-timings, min/median variance, compile times, analytic + cost-model FLOPs,
-MFU) goes to stderr as a JSON object, so it lands in BENCH_r{N}.json's
-tail too.
+compatibility keys; consumers read by name) — on stdout right after the
+headline section completes (crash insurance) AND again as the FINAL
+terminal line, because the driver parses the last line of its capture
+(BENCH_r05.json recorded ``"parsed": null`` when the last line was the
+stderr DETAIL dump). ``value`` is the MEDIAN differenced scan sample; the
+min sample is a supplementary key only (its r5 headline role was
+optimistically biased by up to the 10% admission band). All detail
+(per-bucket timings, min/median variance, compile times, analytic +
+cost-model FLOPs, MFU) goes to stderr as a JSON object, so it lands in
+BENCH_r{N}.json's tail too.
 
 The reference repo publishes no throughput numbers (BASELINE.md: "Throughput
 / latency numbers: none recorded anywhere in repo"), so ``vs_baseline`` is
@@ -756,15 +762,21 @@ def _run_ab_section(pad: int, ctx, detail) -> None:
     cannot hide a regression; measured on forward + train step."""
     import jax
 
-    from deepinteract_tpu.ops.pallas_attention import supports
+    from deepinteract_tpu.models.model import ModelConfig
+    from deepinteract_tpu.ops.pallas_attention import supports_config
     from deepinteract_tpu.training.optim import OptimConfig
     from deepinteract_tpu.training.steps import create_train_state, train_step
 
     n1, n2 = {128: (100, 80), 256: (230, 200)}[pad]
     key = f"attention_ab_b1_p{pad}"
     ab = {}
+    # The measured models come from ctx["make_model"], which builds the
+    # flagship ModelConfig — thread ITS hidden/num_heads into the guard
+    # instead of relying on supports() defaults (ISSUE-2 satellite: the
+    # head-dim floor must evaluate the measured configuration).
+    gnn_cfg = ModelConfig().gnn
     for impl in ("jnp", "pallas"):
-        if impl == "pallas" and not supports(pad):
+        if impl == "pallas" and not supports_config(gnn_cfg, pad):
             ab["pallas"] = {"skipped": f"kernel does not support pad {pad}"}
             continue
         # p256 train needs decoder remat (same HBM constraint as the
@@ -871,67 +883,62 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_bucket_section(name, ctx, detail)
 
 
-def _emit_headline(detail, scan_k) -> None:
-    """Print the ONE stdout contract line from the b1_p128 result (or a
-    value-0 line when the headline bucket failed, so the driver records a
-    failed measurement instead of an empty file). Headline = scanned train
+def _build_headline(detail, scan_k) -> dict:
+    """The stdout contract record from the b1_p128 result (or a value-0
+    record when the headline bucket failed, so the driver records a failed
+    measurement instead of an empty file). Headline = scanned train
     throughput (what a real training run sustains); the per-dispatch step
     figure rides along as a compatibility key (ADVICE r2)."""
     entry = detail["buckets"].get("b1_p128", {})
     if "train_scan_complexes_per_sec" in entry:
-        # Headline value = best (min-time) scan sample: the differenced
-        # protocol's per-rep minimum is a physical lower bound on device
-        # time and is robust to host-side interference stretching the
-        # timed region (measured: a concurrent CPU-bound process inflated
-        # the median rep ~8% while the min stayed put). The median-based
-        # figure rides along for comparison.
+        # Headline value = MEDIAN differenced scan sample (ISSUE-2
+        # satellite, r5 advisor finding): differenced-sample minima are
+        # biased OPTIMISTIC — interference inside the t1 run deflates the
+        # sample — so the r5 min-headline could overstate throughput by up
+        # to its 10% admission band. The min now rides along as a
+        # supplementary key (still useful as a loaded-host cross-check:
+        # a concurrent CPU hog inflates the median ~8% while the min
+        # stays put), admitted under the same clamp/band guards as
+        # before, but it no longer sets value/vs_baseline.
         bs = max(1, int(entry.get("batch", 1)))
+        value = entry["train_scan_complexes_per_sec"]
+        metric = f"train_complexes_per_sec_b1_p128_scan{scan_k}"
+        extra = {"headline_protocol": "median of differenced scan samples"}
         min_s = entry.get("train_scan_ms_per_step_min")
         med_s = entry.get("train_scan_ms_per_step")
         proto = entry.get("scan_timing_protocol", {})
-        # Differenced-sample minima are biased OPTIMISTIC (interference
-        # inside the t1 run deflates the sample), so the min is only
-        # admitted within a tight band under the median: clean runs
-        # measure a 0.7-2.7% min/median gap, so 10% bounds the possible
-        # overstatement while still rescuing a median inflated by a
-        # loaded host (measured +8% under a concurrent CPU hog, min
-        # within 3% of the quiet-run value). Reps that hit the t2<=t1
-        # clamp sentinel disqualify the min outright.
         min_ok = (min_s and med_s
                   and proto.get("clamped_samples", 1) == 0
                   and min_s >= 0.9 * med_s)
         if min_ok:
-            value = bs / (min_s / 1e3)
-            protocol = "min of differenced scan samples"
-        else:
-            value = entry["train_scan_complexes_per_sec"]
-            protocol = "median of differenced scan samples"
-        metric = f"train_complexes_per_sec_b1_p128_scan{scan_k}"
-        extra = {"train_scan_complexes_per_sec_median":
-                 round(entry["train_scan_complexes_per_sec"], 2),
-                 "headline_protocol": protocol}
+            extra["train_scan_complexes_per_sec_min_sample"] = round(
+                bs / (min_s / 1e3), 2)
     elif "train_complexes_per_sec" in entry:
         value = entry["train_complexes_per_sec"]
         metric = "train_step_complexes_per_sec_b1_p128"
         extra = {}
     else:
-        print(json.dumps({
+        return {
             "metric": f"train_complexes_per_sec_b1_p128_scan{scan_k}",
             "value": 0.0, "unit": "complexes/s", "vs_baseline": 0.0,
-        }), flush=True)
-        return
+        }
     line = {
         "metric": metric,
         "value": round(value, 2),
         "unit": "complexes/s",
         "vs_baseline": round(value / CPU_BASELINE_COMPLEXES_PER_SEC, 2),
-        "train_step_complexes_per_sec_b1_p128":
-            round(entry["train_complexes_per_sec"], 2),
         **extra,
     }
+    if "train_complexes_per_sec" in entry:
+        line["train_step_complexes_per_sec_b1_p128"] = round(
+            entry["train_complexes_per_sec"], 2)
     if "analytic_train_mfu" in entry:
         line["analytic_train_mfu"] = round(entry["analytic_train_mfu"], 4)
-    print(json.dumps(line), flush=True)
+    return line
+
+
+def _emit_headline(detail, scan_k) -> None:
+    print(json.dumps(_build_headline(detail, scan_k)), flush=True)
 
 
 def _merge_fragment(detail, fragment) -> None:
@@ -1060,6 +1067,13 @@ def main() -> None:
         "over-count under remat/fusion — cross-check only"
     )
     _log("DETAIL " + json.dumps(detail))
+    # Re-print the contract record as the FINAL terminal line (ISSUE-2
+    # satellite): the driver parses the last line of its capture, and in
+    # r5 that was the multi-hundred-KB "DETAIL ..." stderr dump —
+    # BENCH_r05.json landed with "parsed": null and the headline survived
+    # only in builder logs. The early print after the b1_p128 section
+    # stays as crash insurance; this one is what the capture parses.
+    _emit_headline(detail, scan_k)
 
 
 if __name__ == "__main__":

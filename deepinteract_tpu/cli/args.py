@@ -182,6 +182,33 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
                         "Single-host only (0 = fail fast)")
 
 
+def add_serving_args(p: argparse.ArgumentParser) -> None:
+    """Knobs of the resident inference engine (cli/serve.py; the model /
+    checkpoint surface is shared with train/test/predict via
+    ``build_parser``)."""
+    g = p.add_argument_group("serving")
+    g.add_argument("--host", type=str, default="127.0.0.1")
+    g.add_argument("--port", type=int, default=8008,
+                   help="0 picks a free port (printed at startup)")
+    g.add_argument("--max_batch", type=int, default=8,
+                   help="micro-batch flush size: pending same-bucket "
+                        "requests share one device dispatch once this "
+                        "many are queued")
+    g.add_argument("--max_delay_ms", type=float, default=5.0,
+                   help="max time a lone request waits for batch company "
+                        "before flushing anyway (latency bound)")
+    g.add_argument("--warmup_buckets", type=str, default="",
+                   help="comma list of B1xB2xBATCH shapes compiled at "
+                        "startup (e.g. 128x128x1,128x128x8) so first "
+                        "requests hit warm executables")
+    g.add_argument("--result_cache_size", type=int, default=256,
+                   help="LRU entries of depadded contact maps keyed on a "
+                        "content hash of the featurized complex (0 "
+                        "disables)")
+    g.add_argument("--request_timeout_s", type=float, default=120.0,
+                   help="per-request wait bound inside the HTTP handler")
+
+
 def add_logging_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("logging")
     g.add_argument("--experiment_name", type=str, default=None)

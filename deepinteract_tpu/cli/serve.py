@@ -1,0 +1,80 @@
+"""Serve CLI — resident HTTP inference engine (deepinteract_tpu.serving).
+
+Starts a persistent process that restores the checkpoint once, compiles
+one executable per padded shape bucket (optionally ahead of time via
+``--warmup_buckets``), micro-batches concurrent requests per bucket, and
+answers a JSON API::
+
+    python -m deepinteract_tpu.cli.serve --ckpt_name ckpts/run1 \
+        --port 8008 --warmup_buckets 128x128x1,128x128x8
+
+    curl -X POST --data-binary @complex.npz http://127.0.0.1:8008/predict
+    curl http://127.0.0.1:8008/stats
+
+SIGTERM drains in-flight requests and exits 0 (the PR-1 preemption
+discipline), so rolling restarts never drop accepted work.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Tuple
+
+from deepinteract_tpu.cli.args import add_serving_args, build_parser, configs_from_args
+
+
+def parse_warmup_spec(spec: str) -> Tuple[Tuple[int, int, int], ...]:
+    """``"128x128x1,128x128x8"`` -> ((128, 128, 1), (128, 128, 8)).
+
+    Each entry is bucket_n1 x bucket_n2 x batch; batch defaults to 1 when
+    omitted (``"128x128"``)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dims = [int(v) for v in part.lower().split("x")]
+        if len(dims) == 2:
+            dims.append(1)
+        if len(dims) != 3 or min(dims) < 1:
+            raise ValueError(
+                f"malformed warmup bucket {part!r} (want B1xB2 or B1xB2xBATCH)")
+        out.append(tuple(dims))
+    return tuple(out)
+
+
+def main(argv=None) -> int:
+    parser = build_parser(__doc__)
+    add_serving_args(parser)
+    args = parser.parse_args(argv)
+
+    from deepinteract_tpu.serving import EngineConfig, InferenceEngine, ServingServer
+
+    model_cfg, _, _ = configs_from_args(args)
+    engine_cfg = EngineConfig(
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        warmup_buckets=parse_warmup_spec(args.warmup_buckets),
+        result_cache_size=args.result_cache_size,
+        diagonal_buckets=args.diagonal_buckets,
+        pad_to_max_bucket=args.pad_to_max_bucket,
+        input_indep=args.input_indep,
+    )
+    engine = InferenceEngine(
+        model_cfg,
+        ckpt_dir=args.ckpt_name,
+        cfg=engine_cfg,
+        seed=args.seed,
+        metric_to_track=args.metric_to_track,
+    )
+    server = ServingServer(engine, host=args.host, port=args.port,
+                           request_timeout_s=args.request_timeout_s)
+    host, port = server.address
+    print(f"serving on http://{host}:{port} "
+          f"(buckets warm: {engine.stats()['num_compiled_executables']})",
+          flush=True)
+    return server.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,15 +40,20 @@ def save_complex_npz(
     np.savez_compressed(path, **payload)
 
 
-def load_complex_npz(path: str) -> Dict:
-    with np.load(path, allow_pickle=False) as z:
+def load_complex_npz(path_or_file) -> Dict:
+    """Load a complex from a path OR a binary file-like object (np.load
+    accepts both — the serving layer feeds npz uploads through a BytesIO).
+    ``complex_name`` is optional on read: every in-repo writer emits it,
+    but third-party uploads may not."""
+    with np.load(path_or_file, allow_pickle=False) as z:
         raw1 = {key: z[f"g1_{key}"] for key in GRAPH_KEYS}
         raw2 = {key: z[f"g2_{key}"] for key in GRAPH_KEYS}
         return {
             "graph1": raw1,
             "graph2": raw2,
             "examples": z["examples"],
-            "complex_name": str(z["complex_name"]),
+            "complex_name": (str(z["complex_name"])
+                             if "complex_name" in z else ""),
         }
 
 

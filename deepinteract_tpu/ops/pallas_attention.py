@@ -369,3 +369,17 @@ def supports(n: int, batch: int = 1, knn: int = 20, hidden: int = 128,
     if n <= 128:
         return True
     return n <= MAX_KERNEL_NODES and n % 64 == 0
+
+
+def supports_config(gnn_cfg, n: int, batch: int = 1, knn: int = 20) -> bool:
+    """:func:`supports` with ``hidden``/``num_heads`` taken from a real
+    ``GTConfig`` instead of assumed defaults.
+
+    Call-site guard for code that holds a model config rather than runtime
+    tensor shapes (bench.py's A/B section; the model itself threads the
+    live shapes at ``models/geometric_transformer.py:252``). A caller that
+    passed only ``n`` would silently evaluate the head-dim floor against
+    the flagship defaults instead of the measured configuration (round-5
+    advisor finding)."""
+    return supports(n, batch=batch, knn=knn,
+                    hidden=gnn_cfg.hidden, num_heads=gnn_cfg.num_heads)

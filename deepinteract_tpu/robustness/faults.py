@@ -22,6 +22,8 @@ Registered sites:
 * ``train.nan_batch``  — poisons every float leaf of the batch with NaN
 * ``train.sigterm``    — requests preemption (simulated SIGTERM) at that
   train batch
+* ``checkpoint.snapshot`` — raises RESOURCE_EXHAUSTED at the async
+  checkpoint's on-device snapshot (the transient second state copy)
 
 When no plan is configured every probe is a dict lookup on an empty map —
 effectively free on hot paths.

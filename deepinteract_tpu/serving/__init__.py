@@ -1,0 +1,23 @@
+"""Persistent serving layer: resident engine, micro-batching, HTTP API.
+
+The production counterpart of the one-shot ``cli/predict.py`` path:
+compile once per shape bucket, batch concurrent requests into shared
+device dispatches, cache repeated complexes, and drain cleanly on
+preemption. See ``engine.py`` for the amortization model and
+``server.py`` for the wire protocol.
+"""
+
+from deepinteract_tpu.serving.cache import ResultCache, content_hash
+from deepinteract_tpu.serving.engine import EngineConfig, InferenceEngine
+from deepinteract_tpu.serving.scheduler import MicroBatchScheduler, SchedulerClosed
+from deepinteract_tpu.serving.server import ServingServer
+
+__all__ = [
+    "EngineConfig",
+    "InferenceEngine",
+    "MicroBatchScheduler",
+    "ResultCache",
+    "SchedulerClosed",
+    "ServingServer",
+    "content_hash",
+]

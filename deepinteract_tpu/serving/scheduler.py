@@ -1,0 +1,180 @@
+"""Micro-batching scheduler: coalesce concurrent requests per shape bucket.
+
+The engine's compiled executables are keyed on padded bucket shapes, so
+only same-bucket requests can share a device dispatch. This scheduler
+holds a per-bucket pending queue and flushes a bucket's group when either
+
+* it reaches ``max_batch`` requests (a full batch is ready now), or
+* its oldest request has waited ``max_delay_ms`` (latency bound: a lone
+  request never waits longer than the delay budget for company).
+
+All flushes run on ONE worker thread, which serializes device dispatch —
+correct for a single-accelerator process (concurrent dispatches would just
+queue inside the runtime) and keeps the engine's executable cache free of
+execution races. HTTP handler threads block on the returned futures.
+
+The queue discipline is per-bucket FIFO with oldest-deadline-first
+selection across buckets, so a hot bucket cannot starve a cold one beyond
+the delay budget.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import defaultdict, deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Hashable, List, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class SchedulerClosed(RuntimeError):
+    """submit() after drain(): the serving process is shutting down."""
+
+
+class MicroBatchScheduler:
+    """Groups pending requests by bucket key and flushes on ``max_batch``
+    or ``max_delay_ms``.
+
+    ``flush_fn(key, payloads) -> results`` executes one coalesced batch
+    and must return one result per payload (in order); it runs on the
+    worker thread. An exception from ``flush_fn`` fails every future in
+    the group (the batch shares one dispatch, so there is no per-item
+    failure to attribute).
+    """
+
+    def __init__(
+        self,
+        flush_fn: Callable[[Hashable, List[Any]], List[Any]],
+        max_batch: int = 8,
+        max_delay_ms: float = 5.0,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._flush_fn = flush_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = max(0.0, float(max_delay_ms)) / 1e3
+        self._cv = threading.Condition()
+        # key -> deque[(payload, future, enqueue_time)]
+        self._pending: Dict[Hashable, deque] = defaultdict(deque)
+        self._closed = False
+        self._flushes = 0
+        self._coalesced: Dict[int, int] = defaultdict(int)  # batch size -> count
+        self._submitted = 0
+        self._worker = threading.Thread(
+            target=self._loop, name="microbatch-flush", daemon=True
+        )
+        self._worker.start()
+
+    # -- producer side ----------------------------------------------------
+
+    def submit(self, key: Hashable, payload: Any) -> Future:
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise SchedulerClosed("scheduler is draining; no new requests")
+            self._pending[key].append((payload, fut, time.monotonic()))
+            self._submitted += 1
+            self._cv.notify()
+        return fut
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Stop accepting requests, flush everything pending, and join the
+        worker. Idempotent; safe to call from any thread (SIGTERM drain).
+
+        Returns False (and logs loudly) when the worker is still flushing
+        at the timeout — the caller is about to exit with accepted work
+        in flight (e.g. several cold-bucket compiles queued behind a
+        SIGTERM), which must not pass silently as a clean drain."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._worker.join(timeout=timeout)
+        if self._worker.is_alive():
+            logger.error(
+                "drain timed out after %.0fs with %d request(s) still "
+                "pending — exiting now would drop accepted work",
+                timeout, self.stats()["queue_depth"])
+            return False
+        return True
+
+    # -- worker side ------------------------------------------------------
+
+    def _take_ready_group(self) -> Tuple[Hashable, List]:
+        """Under the lock: pop the group that should flush now, or
+        (None, wait_seconds) if nothing is ready yet. Ready-bucket choice
+        and the wake-up time are tracked SEPARATELY: a not-yet-ready
+        bucket's earlier deadline must influence when to wake, but never
+        which ready bucket flushes first (conflating them let a pending
+        bucket shadow an older-deadline ready one)."""
+        now = time.monotonic()
+        ready_key = None
+        ready_deadline = None
+        wake_deadline = None
+        for key, q in self._pending.items():
+            if not q:
+                continue
+            deadline = q[0][2] + self.max_delay_s
+            if len(q) >= self.max_batch or now >= deadline or self._closed:
+                # Oldest-deadline-first across READY buckets.
+                if ready_key is None or deadline < ready_deadline:
+                    ready_key, ready_deadline = key, deadline
+            elif wake_deadline is None or deadline < wake_deadline:
+                wake_deadline = deadline
+        if ready_key is not None:
+            q = self._pending[ready_key]
+            group = [q.popleft() for _ in range(min(len(q), self.max_batch))]
+            if not q:
+                del self._pending[ready_key]
+            return ready_key, group
+        wait = None if wake_deadline is None else max(0.0, wake_deadline - now)
+        return None, wait
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                key, group_or_wait = self._take_ready_group()
+                if key is None:
+                    if self._closed and not self._pending:
+                        return
+                    self._cv.wait(timeout=group_or_wait)
+                    continue
+            group = group_or_wait
+            payloads = [p for p, _, _ in group]
+            try:
+                results = self._flush_fn(key, payloads)
+                if len(results) != len(payloads):
+                    raise RuntimeError(
+                        f"flush_fn returned {len(results)} results for "
+                        f"{len(payloads)} payloads"
+                    )
+            except BaseException as exc:  # noqa: BLE001 - fanned out to futures
+                for _, fut, _ in group:
+                    if not fut.cancelled():
+                        fut.set_exception(exc)
+                continue
+            finally:
+                with self._cv:
+                    self._flushes += 1
+                    self._coalesced[len(group)] += 1
+            for (_, fut, _), result in zip(group, results):
+                if not fut.cancelled():
+                    fut.set_result(result)
+
+    # -- observability ----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            depth = {str(k): len(q) for k, q in self._pending.items() if q}
+            return {
+                "queue_depth": sum(len(q) for q in self._pending.values()),
+                "queue_depth_by_bucket": depth,
+                "submitted": self._submitted,
+                "flushes": self._flushes,
+                "batch_size_histogram": dict(sorted(self._coalesced.items())),
+                "max_batch": self.max_batch,
+                "max_delay_ms": self.max_delay_s * 1e3,
+                "draining": self._closed,
+            }

@@ -1,0 +1,263 @@
+"""Stdlib HTTP JSON API over the :class:`InferenceEngine`.
+
+No web framework — ``http.server.ThreadingHTTPServer`` is enough for a
+JSON control plane whose heavy lifting (batching, compile reuse) lives in
+the engine: handler threads just parse the upload, enqueue, and block on
+the future while the scheduler thread owns device dispatch.
+
+Endpoints:
+
+* ``POST /predict`` — body is either a complex ``.npz`` upload
+  (``data/io.py`` schema, ``Content-Type: application/octet-stream``) or
+  a JSON object with ``{"npz_path": ...}`` / ``{"left_pdb": ...,
+  "right_pdb": ...}`` featurized server-side via ``pipeline/pair.py``.
+  Response: ``{"complex_name", "n1", "n2", "bucket", "cached",
+  "coalesced", "latency_ms", "contact_probs": [[...]]}``.
+* ``GET /healthz`` — liveness + draining flag.
+* ``GET /stats`` — queue depth, per-bucket compile inventory, result-cache
+  hit rate, and request-latency percentiles.
+
+Shutdown: ``run()`` installs the PR-1 :class:`PreemptionGuard`; on
+SIGTERM/SIGINT the server stops accepting (``503`` on new predicts),
+drains in-flight requests through the scheduler, answers their responses,
+and returns 0 — the same cooperative-drain discipline training's
+preemption path uses.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepinteract_tpu.data.io import GRAPH_KEYS
+from deepinteract_tpu.robustness.preemption import PreemptionGuard
+from deepinteract_tpu.serving.engine import InferenceEngine
+from deepinteract_tpu.serving.scheduler import SchedulerClosed
+
+logger = logging.getLogger(__name__)
+
+
+def raw_from_npz_bytes(body: bytes) -> Dict:
+    """An uploaded ``.npz`` complex (the exact ``save_complex_npz``
+    schema) -> raw dict, without touching the filesystem. Schema
+    construction is delegated to ``data/io.py:load_complex_npz`` (the one
+    reader) — only the clearer missing-key message lives here."""
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        missing = [k for p in ("g1", "g2")
+                   for k in (f"{p}_{key}" for key in GRAPH_KEYS)
+                   if k not in z] + [k for k in ("examples",) if k not in z]
+        if missing:
+            raise ValueError(f"npz upload missing keys: {missing}")
+    from deepinteract_tpu.data.io import load_complex_npz
+
+    return load_complex_npz(io.BytesIO(body))
+
+
+def raw_from_json(payload: Dict) -> Dict:
+    """JSON request body -> raw complex dict (path-based variants)."""
+    if "npz_path" in payload:
+        from deepinteract_tpu.data.io import load_complex_npz
+
+        return load_complex_npz(payload["npz_path"])
+    if "left_pdb" in payload and "right_pdb" in payload:
+        from deepinteract_tpu.pipeline.pair import convert_pdb_pair_to_complex
+
+        return convert_pdb_pair_to_complex(
+            payload["left_pdb"], payload["right_pdb"], with_labels=False)
+    raise ValueError(
+        "JSON body must contain 'npz_path' or both 'left_pdb' and "
+        "'right_pdb' (or upload npz bytes as application/octet-stream)")
+
+
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """stdlib's handle_error prints a traceback banner to stderr for any
+    handler-thread exception — including routine client disconnects and
+    keep-alive sockets torn down by a drain. Route it to debug logging;
+    real request failures are already answered as 4xx/5xx JSON by the
+    handler itself."""
+
+    def handle_error(self, request, client_address):  # noqa: N802
+        logger.debug("connection error from %s", client_address,
+                     exc_info=True)
+
+
+class _LatencyTracker:
+    """Rolling request-latency window -> percentiles for /stats."""
+
+    def __init__(self, window: int = 2048):
+        self._lat = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append(seconds)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = np.asarray(self._lat, dtype=np.float64)
+        if lat.size == 0:
+            return {"count": 0}
+        return {
+            "count": int(lat.size),
+            "p50_ms": float(np.percentile(lat, 50) * 1e3),
+            "p90_ms": float(np.percentile(lat, 90) * 1e3),
+            "p99_ms": float(np.percentile(lat, 99) * 1e3),
+            "max_ms": float(lat.max() * 1e3),
+        }
+
+
+class ServingServer:
+    """Engine + ThreadingHTTPServer + cooperative drain."""
+
+    def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
+                 port: int = 8008, request_timeout_s: float = 120.0):
+        self.engine = engine
+        self.latency = _LatencyTracker()
+        self._draining = threading.Event()
+        self.request_timeout_s = request_timeout_s
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Handler threads must not outlive a drain by minutes on a
+            # stuck client; keep stdlib defaults otherwise.
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+                logger.debug("http: " + fmt, *args)
+
+            def _send_json(self, code: int, payload: Dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - stdlib name
+                if self.path == "/healthz":
+                    self._send_json(200, {
+                        "status": "draining" if server._draining.is_set()
+                        else "ok",
+                        "draining": server._draining.is_set(),
+                    })
+                elif self.path == "/stats":
+                    self._send_json(200, server.stats())
+                else:
+                    self._send_json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):  # noqa: N802 - stdlib name
+                if self.path != "/predict":
+                    self._send_json(404, {"error": f"no route {self.path}"})
+                    return
+                if server._draining.is_set():
+                    self._send_json(503, {"error": "server is draining"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "")
+                    if ctype.startswith("application/json"):
+                        raw = raw_from_json(json.loads(body.decode()))
+                    else:
+                        raw = raw_from_npz_bytes(body)
+                except Exception as exc:  # noqa: BLE001 - client error
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                t0 = time.monotonic()
+                try:
+                    result = server.engine.predict(
+                        raw, timeout=server.request_timeout_s)
+                except SchedulerClosed:
+                    self._send_json(503, {"error": "server is draining"})
+                    return
+                except Exception as exc:  # noqa: BLE001 - surfaced to client
+                    logger.exception("predict failed")
+                    self._send_json(500, {"error": str(exc)})
+                    return
+                latency = time.monotonic() - t0
+                server.latency.record(latency)
+                self._send_json(200, {
+                    "complex_name": raw.get("complex_name", ""),
+                    "n1": result["n1"],
+                    "n2": result["n2"],
+                    "bucket": list(result["bucket"]),
+                    "cached": result["cached"],
+                    "coalesced": result.get("coalesced", 1),
+                    "latency_ms": latency * 1e3,
+                    "contact_probs": np.asarray(
+                        result["probs"], dtype=np.float64).tolist(),
+                })
+
+        self.httpd = _QuietThreadingHTTPServer((host, port), Handler)
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self.httpd.server_address[:2]
+        return str(host), int(port)
+
+    def serve_background(self) -> None:
+        """Start accepting connections on a daemon thread (used by run()
+        and by tests; production entry is run())."""
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="http-serve", daemon=True)
+        self._serve_thread.start()
+
+    def drain(self) -> None:
+        """Stop accepting new predicts, finish in-flight ones, stop the
+        listener. Idempotent."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        # Flush everything still queued; handler threads blocked on their
+        # futures get their responses before the listener goes away.
+        self.engine.close()
+        self.httpd.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10.0)
+        self.httpd.server_close()
+
+    def run(self, guard: Optional[PreemptionGuard] = None,
+            poll_seconds: float = 0.25) -> int:
+        """Blocking serve loop with the PR-1 preemption discipline:
+        SIGTERM/SIGINT -> drain in-flight requests -> exit 0. ``guard`` is
+        injectable for tests (flag-only mode outside the main thread)."""
+        own_guard = guard is None
+        guard = guard or PreemptionGuard(log=logger.warning)
+        if own_guard:
+            guard.__enter__()
+        try:
+            self.serve_background()
+            host, port = self.address
+            logger.info("serving on http://%s:%d (POST /predict, "
+                        "GET /healthz, GET /stats)", host, port)
+            while not guard.requested:
+                time.sleep(poll_seconds)
+            logger.warning("drain requested (%s): refusing new requests, "
+                           "flushing %d queued",
+                           guard.reason,
+                           self.engine.scheduler.stats()["queue_depth"])
+        finally:
+            self.drain()
+            if own_guard:
+                guard.__exit__(None, None, None)
+        return 0
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "engine": self.engine.stats(),
+            "latency": self.latency.stats(),
+            "draining": self._draining.is_set(),
+        }

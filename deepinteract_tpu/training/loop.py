@@ -115,7 +115,9 @@ class LoopConfig:
     # transport the fetch alone measured 15-24 s/epoch (91 s before the
     # packed fetch) — 10-43% of a steady sustained epoch. False restores
     # the synchronous save (saves are always drained before fit returns
-    # either way).
+    # either way). If the snapshot's transient second params+opt_state
+    # copy exhausts device memory, the loop logs a downgrade and falls
+    # back to synchronous saves instead of failing the run.
     async_checkpoint: bool = True
 
 
@@ -549,13 +551,39 @@ class Trainer:
                 lambda t: jax.tree_util.tree_map(jnp.copy, t))
 
         def submit_save(step_no: int, st: TrainState, metrics: dict) -> None:
-            nonlocal pending
+            nonlocal pending, saver, snapshot
             if saver is None:
                 ckpt.save(step_no, state_to_tree(st), metrics)
                 return
             if pending is not None:
                 pending.result()
-            tree = snapshot(_state_dict(st))
+            # The on-device snapshot holds a TRANSIENT second params +
+            # opt_state copy. A config sized to the chip without that
+            # headroom hits RESOURCE_EXHAUSTED here — which must downgrade
+            # to the synchronous save path (no extra copy), not OOM-kill a
+            # run that fits otherwise. block_until_ready forces the
+            # allocation to surface at this try (async dispatch would
+            # defer it to the worker's fetch next epoch).
+            try:
+                faults.maybe_raise(
+                    "checkpoint.snapshot",
+                    lambda: RuntimeError(
+                        "RESOURCE_EXHAUSTED: injected snapshot OOM"))
+                tree = snapshot(_state_dict(st))
+                jax.block_until_ready(tree)
+            except Exception as exc:
+                if not _is_resource_exhausted(exc):
+                    raise
+                self.log(
+                    "async checkpoint snapshot exhausted device memory "
+                    f"({str(exc).splitlines()[0][:160]}); downgrading to "
+                    "synchronous saves for the rest of the run"
+                )
+                saver.shutdown(wait=True)
+                saver = None
+                snapshot = None
+                ckpt.save(step_no, state_to_tree(st), metrics)
+                return
             pending = saver.submit(
                 lambda tr=tree, sn=step_no, me=dict(metrics):
                     ckpt.save(sn, _fetch_tree(tr), me))
@@ -954,6 +982,18 @@ class Trainer:
         for k, v in metrics.items():
             if isinstance(v, (int, float)) and not math.isnan(float(v)):
                 self.metric_writer.add_scalar(k, float(v), epoch)
+
+
+def _is_resource_exhausted(exc: Exception) -> bool:
+    """Device-memory exhaustion signatures across jax/XLA versions and
+    backends (XlaRuntimeError carries 'RESOURCE_EXHAUSTED: ...'; PJRT CPU/
+    GPU allocators phrase it 'Out of memory' / 'Failed to allocate')."""
+    msg = str(exc)
+    lowered = msg.lower()
+    return ("RESOURCE_EXHAUSTED" in msg
+            or "resource exhausted" in lowered
+            or "out of memory" in lowered
+            or "failed to allocate" in lowered)
 
 
 def _complex_ce(logits: np.ndarray, examples: np.ndarray, mask: np.ndarray) -> float:

@@ -714,3 +714,68 @@ def test_resume_restores_optimizer_state_and_best_k(toy_data, tmp_path):
     assert ck_a.latest_step() == ck_b.latest_step() == 3
     ck_a.close()
     ck_b.close()
+
+
+def test_async_snapshot_oom_downgrades_to_sync_save(toy_data, tmp_path):
+    """RESOURCE_EXHAUSTED at the async checkpoint's on-device snapshot
+    (the transient second params+opt_state copy) must downgrade the run to
+    synchronous saves with a logged reason — not OOM-fail a config that
+    fits without the extra copy. The epoch that hit the fault still saves
+    (synchronously), and so does every later epoch."""
+    from deepinteract_tpu.training.checkpoint import CheckpointConfig, Checkpointer
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    faults.configure({"checkpoint.snapshot": [1]})  # first epoch's snapshot
+    logs = []
+    ckpt_dir = str(tmp_path / "ckpt")
+    trainer = Trainer(
+        ToyContactModel(),
+        LoopConfig(ckpt_dir=ckpt_dir, num_epochs=3, log_every=0,
+                   patience=50, eval_batches_per_dispatch=1,
+                   async_checkpoint=True),
+        OptimConfig(lr=1e-2, steps_per_epoch=4, num_epochs=3),
+        log_fn=logs.append,
+    )
+    state = trainer.init_state(toy_data[0])
+    state, history = trainer.fit(state, toy_data, val_data=toy_data[:1])
+    assert len(history) == 3
+    assert any("downgrading to synchronous saves" in line for line in logs)
+    # All three epoch checkpoints landed despite the snapshot fault.
+    ck = Checkpointer(CheckpointConfig(directory=ckpt_dir))
+    assert ck.latest_step() == 3
+    ck.close()
+
+
+def test_non_oom_snapshot_error_still_raises(toy_data, tmp_path):
+    """Only resource exhaustion downgrades; any other snapshot failure
+    must stay loud (a silently swallowed bug would skip checkpoints)."""
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    trainer = Trainer(
+        ToyContactModel(),
+        LoopConfig(ckpt_dir=str(tmp_path / "ckpt"), num_epochs=1,
+                   log_every=0, patience=50, eval_batches_per_dispatch=1,
+                   async_checkpoint=True),
+        OptimConfig(lr=1e-2, steps_per_epoch=4, num_epochs=1),
+        log_fn=lambda s: None,
+    )
+    state = trainer.init_state(toy_data[0])
+    # Inject through the same probe point but with a non-OOM exception
+    # class: the downgrade must not catch it.
+    faults.configure({"checkpoint.snapshot": [1]})
+    import deepinteract_tpu.robustness.faults as faults_mod
+
+    original_maybe_raise = faults_mod.maybe_raise
+
+    def raise_value_error(site, make_exc):
+        if site == "checkpoint.snapshot" and faults_mod.fire(site):
+            raise ValueError("snapshot exploded (not an OOM)")
+
+    faults_mod.maybe_raise = raise_value_error
+    try:
+        with pytest.raises(ValueError, match="not an OOM"):
+            trainer.fit(state, toy_data)
+    finally:
+        faults_mod.maybe_raise = original_maybe_raise

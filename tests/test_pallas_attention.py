@@ -79,6 +79,27 @@ def test_supports_guard():
     assert supports(128, hidden=64, num_heads=4)
 
 
+def test_supports_config_threads_real_model_shape():
+    """supports_config must evaluate the CONFIG's hidden/num_heads, not
+    the flagship defaults — a config the head-dim floor rejects must be
+    rejected even though supports(n) alone would pass (ISSUE-2 satellite:
+    bench.py's A/B guard used to pass only the pad)."""
+    from deepinteract_tpu.models.geometric_transformer import GTConfig
+    from deepinteract_tpu.models.model import ModelConfig
+    from deepinteract_tpu.ops.pallas_attention import supports_config
+
+    flagship = ModelConfig().gnn
+    assert supports_config(flagship, 128)
+    assert supports_config(flagship, 128) == supports(
+        128, hidden=flagship.hidden, num_heads=flagship.num_heads)
+    tiny = GTConfig(hidden=8, num_heads=2)
+    assert supports(128) and not supports_config(tiny, 128)
+    headdim_floor = GTConfig(hidden=64, num_heads=8)
+    assert not supports_config(headdim_floor, 128)
+    # Batch/knn still thread through alongside the config.
+    assert not supports_config(flagship, 128, batch=16)
+
+
 def test_forward_parity_blocked_256(rng):
     """The >128-node edge-block grid path (4 blocks at n=256) must match
     the jnp scatter reference, including the cross-block accumulation and

@@ -1,0 +1,377 @@
+"""Serving-layer tests: compile-cache warm path, micro-batch coalescing,
+result cache, HTTP round trip, and SIGTERM-style drain.
+
+All CPU-friendly and in the fast tier (tiny model — the suite pins the
+serving *machinery*, not the architecture). The engine/server fixtures
+are module-scoped to pay the two executable compiles once; the drain test
+is last in the file by design (it shuts the shared scheduler down), which
+holds because the quick tier runs tests in file order (no randomizer,
+pyproject addopts).
+"""
+
+import http.client
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.data.io import save_complex_npz
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import ModelConfig
+from deepinteract_tpu.robustness.preemption import PreemptionGuard
+from deepinteract_tpu.serving import (
+    EngineConfig,
+    InferenceEngine,
+    MicroBatchScheduler,
+    ResultCache,
+    SchedulerClosed,
+    ServingServer,
+    content_hash,
+)
+
+from tests.test_data_layer import make_raw_complex
+
+KNN, GEO = 6, 2  # every test complex shares one (knn, geo) signature
+
+
+def tiny_model_cfg():
+    return ModelConfig(
+        gnn=GTConfig(num_layers=2, hidden=16, num_heads=2, shared_embed=8,
+                     dropout_rate=0.0),
+        decoder=DecoderConfig(num_chunks=1, num_channels=8, dilation_cycle=(1,)),
+    )
+
+
+def fresh_raw(seed, n1=20, n2=16):
+    return make_raw_complex(n1, n2, np.random.default_rng(seed), knn=KNN)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InferenceEngine(
+        tiny_model_cfg(),
+        cfg=EngineConfig(max_batch=8, max_delay_ms=25.0,
+                         result_cache_size=64),
+    )
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def server(engine):
+    srv = ServingServer(engine, port=0)
+    guard = PreemptionGuard(log=lambda s: None)  # flag-only off main thread
+    rc = {}
+    thread = threading.Thread(
+        target=lambda: rc.__setitem__("rc", srv.run(guard=guard)), daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 5.0
+    while srv._serve_thread is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    yield srv, guard, thread, rc
+    guard.request("fixture teardown")  # idempotent with the drain test
+    thread.join(timeout=15.0)
+
+
+# ---------------------------------------------------------------------------
+# cache.py / scheduler.py units (no jax, no compiles)
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_lru_eviction_and_stats():
+    cache = ResultCache(capacity=2)
+    cache.put("a", 1)
+    cache.put("b", 2)
+    assert cache.get("a") == 1  # refreshes recency: b is now LRU
+    cache.put("c", 3)
+    assert cache.get("b") is None and cache.get("c") == 3
+    s = cache.stats()
+    assert s["size"] == 2 and s["hits"] == 2 and s["misses"] == 1
+    disabled = ResultCache(capacity=0)
+    disabled.put("x", 1)
+    assert disabled.get("x") is None
+
+
+def test_content_hash_sensitive_to_features_and_flags():
+    raw_a, raw_b = fresh_raw(1), fresh_raw(2)
+    assert content_hash(raw_a) == content_hash(raw_a)
+    assert content_hash(raw_a) != content_hash(raw_b)
+    # A one-element feature change must change the key.
+    import copy
+
+    raw_c = copy.deepcopy(raw_a)
+    raw_c["graph1"]["node_feats"][0, 0] += 1.0
+    assert content_hash(raw_a) != content_hash(raw_c)
+    # Engine-level flags that change the math are part of the key.
+    assert (content_hash(raw_a, extra=("input_indep", False))
+            != content_hash(raw_a, extra=("input_indep", True)))
+
+
+def test_scheduler_coalesces_full_batch_and_partial_on_delay():
+    flushed = []
+
+    def flush(key, payloads):
+        flushed.append((key, list(payloads)))
+        return [p * 10 for p in payloads]
+
+    sched = MicroBatchScheduler(flush, max_batch=4, max_delay_ms=40.0)
+    try:
+        # Full batch flushes immediately (no delay wait).
+        futs = [sched.submit("k", i) for i in range(4)]
+        assert [f.result(timeout=5) for f in futs] == [0, 10, 20, 30]
+        assert flushed[-1] == ("k", [0, 1, 2, 3])
+        # Partial group flushes once the oldest request ages out.
+        t0 = time.monotonic()
+        futs = [sched.submit("k", i) for i in (7, 8)]
+        assert [f.result(timeout=5) for f in futs] == [70, 80]
+        assert time.monotonic() - t0 >= 0.03  # waited ~max_delay for company
+        assert flushed[-1] == ("k", [7, 8])
+        # Different keys never share a flush.
+        fa, fb = sched.submit("a", 1), sched.submit("b", 2)
+        fa.result(timeout=5), fb.result(timeout=5)
+        assert {k for k, _ in flushed[-2:]} == {"a", "b"}
+        hist = sched.stats()["batch_size_histogram"]
+        assert hist.get(4) == 1 and hist.get(2) == 1
+    finally:
+        sched.drain()
+
+
+def test_scheduler_drain_flushes_pending_then_rejects():
+    flushed = []
+
+    def flush(key, payloads):
+        flushed.append(list(payloads))
+        return list(payloads)
+
+    sched = MicroBatchScheduler(flush, max_batch=8, max_delay_ms=10_000.0)
+    fut = sched.submit("k", 42)  # would wait 10 s for company
+    sched.drain(timeout=10)
+    assert fut.result(timeout=1) == 42  # drain flushed it immediately
+    with pytest.raises(SchedulerClosed):
+        sched.submit("k", 43)
+    assert sched.stats()["draining"]
+
+
+def test_scheduler_flush_error_fails_the_whole_group():
+    def flush(key, payloads):
+        raise RuntimeError("device fell over")
+
+    sched = MicroBatchScheduler(flush, max_batch=2, max_delay_ms=5.0)
+    try:
+        futs = [sched.submit("k", i) for i in range(2)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="fell over"):
+                f.result(timeout=5)
+    finally:
+        sched.drain()
+
+
+# ---------------------------------------------------------------------------
+# engine.py (shared compiled engine)
+# ---------------------------------------------------------------------------
+
+
+def test_warm_bucket_triggers_zero_new_traces(engine):
+    """ISSUE-2 acceptance: a warm repeat request through the engine
+    performs ZERO new jit traces — counted by a Python side effect inside
+    the traced function, so a silent retrace cannot hide."""
+    out = engine.predict(fresh_raw(10))
+    assert out["probs"].shape == (20, 16)
+    assert out["bucket"] == (64, 64) and not out["cached"]
+    s1 = engine.stats()
+    assert s1["trace_count"] == 1 and s1["num_compiled_executables"] == 1
+
+    # Different content, same bucket: must reuse the compiled executable.
+    out2 = engine.predict(fresh_raw(11))
+    s2 = engine.stats()
+    assert s2["trace_count"] == s1["trace_count"]  # zero new traces
+    assert s2["num_compiled_executables"] == s1["num_compiled_executables"]
+    assert not np.array_equal(out["probs"], out2["probs"])
+    # A different shape signature (new lengths -> same bucket) still warm;
+    # probabilities are well-formed.
+    assert np.all(out2["probs"] >= 0) and np.all(out2["probs"] <= 1)
+
+
+def test_result_cache_returns_identical_map_without_device_work(engine):
+    raw = fresh_raw(20)
+    first = engine.predict(raw)
+    executed_before = engine.stats()["executed_requests"]
+    hits_before = engine.cache.stats()["hits"]
+    second = engine.predict(raw)
+    assert second["cached"] and not first["cached"]
+    np.testing.assert_array_equal(first["probs"], second["probs"])
+    assert engine.stats()["executed_requests"] == executed_before
+    assert engine.cache.stats()["hits"] == hits_before + 1
+
+
+def test_concurrent_submits_coalesce_into_one_dispatch(engine):
+    # Featurize BEFORE submitting: generation takes longer than the
+    # 25 ms delay window, and a slow producer is exactly the case where
+    # a partial flush is correct — here we pin the full-batch path.
+    raws = [fresh_raw(100 + i) for i in range(8)]
+    flushes_before = engine.stats()["scheduler"]["flushes"]
+    futs = [engine.submit(raw) for raw in raws]
+    results = [f.result(timeout=120) for f in futs]
+    assert all(r["coalesced"] == 8 for r in results)
+    s = engine.stats()
+    assert s["scheduler"]["flushes"] == flushes_before + 1
+    assert s["scheduler"]["batch_size_histogram"].get(8, 0) >= 1
+    # Each request got ITS OWN depadded map (no cross-slot mixups).
+    assert len({r["probs"].tobytes() for r in results}) == 8
+
+
+def test_batched_queue_beats_sequential_predicts(engine):
+    """ISSUE-2 acceptance: N>=8 queued same-bucket requests achieve
+    strictly higher complexes/sec than N sequential predict() calls in the
+    same process. Both executables are warm before timing, so this
+    measures the serving path itself (batch sharing one dispatch + no
+    per-request delay wait), not compile luck."""
+    engine.warmup([(64, 64, 1), (64, 64, 8)], knn=KNN, geo=GEO)
+    n = 8
+    seq_raws = [fresh_raw(200 + i) for i in range(n)]
+    t0 = time.monotonic()
+    for raw in seq_raws:
+        engine.predict(raw)
+    sequential_s = time.monotonic() - t0
+
+    bat_raws = [fresh_raw(300 + i) for i in range(n)]
+    t0 = time.monotonic()
+    futs = [engine.submit(raw) for raw in bat_raws]
+    for fut in futs:
+        fut.result(timeout=120)
+    batched_s = time.monotonic() - t0
+    assert n / batched_s > n / sequential_s, (batched_s, sequential_s)
+
+
+def test_over_bucket_complexes_lift_both_sides_to_tile_multiples(engine):
+    # In-bucket shapes follow the loader policy verbatim...
+    assert engine.bucket_for(20, 16) == (64, 64)
+    assert engine.bucket_for(100, 200) == (128, 256)
+    # ...over-bucket chains pad to top-bucket multiples with the partner
+    # lifted to a tile multiple too (tiled decode needs both divisible).
+    assert engine.bucket_for(300, 40) == (512, 256)
+    assert engine.bucket_for(600, 300) == (768, 512)
+    # The engine forces the tiled decoder on so those shapes can run.
+    assert engine.model.cfg.tile_pair_map
+
+
+def test_shape_signature_covers_both_graphs(engine):
+    """An upload whose graph2 was featurized at a different K/geo must
+    never share a batch (or an executable) with a symmetric complex —
+    keying on graph1 alone would dispatch it through mismatched avals
+    and fail its whole coalesced group."""
+    import copy
+
+    raw = fresh_raw(600)
+    sym = engine._shape_signature(raw)
+    assert sym[0] == sym[1] == (KNN, GEO, 113, 28)
+    asym = copy.deepcopy(raw)
+    g2 = asym["graph2"]
+    g2["nbr_idx"] = g2["nbr_idx"][:, : KNN - 2]
+    g2["edge_feats"] = g2["edge_feats"][:, : KNN - 2]
+    g2["src_nbr_eids"] = g2["src_nbr_eids"][:, : KNN - 2]
+    g2["dst_nbr_eids"] = g2["dst_nbr_eids"][:, : KNN - 2]
+    assert engine._shape_signature(asym) != sym
+
+
+def test_batch_slots_inventory_is_power_of_two_capped(engine):
+    assert [engine._batch_slots(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    # Warmup specs normalize onto keys the request path can actually hit
+    # (bucketized pads, power-of-two batch capped at max_batch).
+    assert engine.normalize_warmup(128, 128, 6) == (128, 128, 8)
+    assert engine.normalize_warmup(300, 300, 2) == (512, 512, 2)
+    assert engine.normalize_warmup(64, 64, 99) == (64, 64, 8)
+
+
+# ---------------------------------------------------------------------------
+# server.py (HTTP round trip + drain; drain test LAST — it stops the
+# shared engine's scheduler)
+# ---------------------------------------------------------------------------
+
+
+def _post_npz(host, port, raw, timeout=120):
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "c.npz")
+        save_complex_npz(path, raw["graph1"], raw["graph2"], raw["examples"],
+                         raw.get("complex_name", "c"))
+        with open(path, "rb") as fh:
+            body = fh.read()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/predict", body=body,
+                     headers={"Content-Type": "application/octet-stream"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _get(host, port, path, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def test_http_predict_and_stats_round_trip(server):
+    srv, _, _, _ = server
+    host, port = srv.address
+    status, health = _get(host, port, "/healthz")
+    assert status == 200 and health["status"] == "ok"
+
+    raw = fresh_raw(400)
+    status, out = _post_npz(host, port, raw)
+    assert status == 200
+    assert out["n1"] == 20 and out["n2"] == 16 and out["bucket"] == [64, 64]
+    probs = np.asarray(out["contact_probs"])
+    assert probs.shape == (20, 16)
+    assert np.all(probs >= 0) and np.all(probs <= 1)
+    # Wire result == engine result for the same upload (cache round trip).
+    direct = srv.engine.predict(raw)
+    assert direct["cached"]
+    np.testing.assert_allclose(probs, direct["probs"], rtol=1e-6)
+
+    status, stats = _get(host, port, "/stats")
+    assert status == 200
+    eng = stats["engine"]
+    assert eng["num_compiled_executables"] >= 1  # compile inventory
+    assert "queue_depth" in eng["scheduler"]
+    assert 0.0 <= eng["result_cache"]["hit_rate"] <= 1.0
+    assert stats["latency"]["count"] >= 1 and stats["latency"]["p50_ms"] > 0
+    # Malformed upload -> client error, not a 500.
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("POST", "/predict", body=b"not an npz",
+                     headers={"Content-Type": "application/octet-stream"})
+        assert conn.getresponse().status == 400
+    finally:
+        conn.close()
+
+
+def test_sigterm_drain_completes_inflight_then_refuses(server):
+    """PR-1 preemption discipline over the serving stack: a drain request
+    (the SIGTERM handler's effect) finishes queued work, answers it, then
+    stops the listener — accepted requests are never dropped."""
+    srv, guard, thread, rc = server
+    host, port = srv.address
+    # Queue a request that would otherwise wait max_delay_ms for company…
+    fut = srv.engine.submit(fresh_raw(500))
+    # …then pull the plug mid-flight.
+    guard.request("test SIGTERM")
+    out = fut.result(timeout=30)  # drain flushed it, not dropped it
+    assert out["probs"].shape == (20, 16)
+    thread.join(timeout=30)
+    assert not thread.is_alive() and rc.get("rc") == 0
+    # New work is refused: scheduler closed, listener gone.
+    with pytest.raises(SchedulerClosed):
+        srv.engine.submit(fresh_raw(501))
+    with pytest.raises(OSError):
+        _get(host, port, "/healthz", timeout=2)

@@ -212,6 +212,8 @@ def test_multi_step_matches_sequential(data, optim_cfg):
     model = tiny_model()
     state_a = create_train_state(model, data[0], optim_cfg=optim_cfg)
     state_b = create_train_state(model, data[0], optim_cfg=optim_cfg)
+    # Same seed => identical inits; keep a host copy as the update origin.
+    params0 = jax.tree_util.tree_map(np.asarray, state_a.params)
 
     seq_losses = []
     for b in data:
@@ -226,17 +228,35 @@ def test_multi_step_matches_sequential(data, optim_cfg):
     # (different fusion order than the unscanned step) AMPLIFIED by AdamW:
     # the rsqrt(v) normalizer turns ~1e-7 gradient rounding differences on
     # near-zero-gradient params into update differences approaching the
-    # lr, so the meaningful bound scales with the total update magnitude
-    # (lr * steps), not the param values. Losses above are the tight math
-    # check; here we bound drift to 10% of the total update. (The r5
-    # depad-stats decoder shifted association enough to break the old
-    # value-scaled 5e-5 atol while every executed-parity test still
-    # passes at 1e-5 forward.)
-    drift_bound = 0.1 * optim_cfg.lr * len(data)
-    for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
-                    jax.tree_util.tree_leaves(state_b.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-3, atol=drift_bound)
+    # lr. The right parity measure is therefore relative to the UPDATE,
+    # not the param values — but the r5 loosening (atol =
+    # 0.1*lr*len(data), a flat per-element value bound ~100x the old one)
+    # let a leaf whose entire update diverged by 10% pass silently
+    # (ISSUE-2 satellite, round-5 advisor). Re-tightened two-regime bound
+    # on the normalized per-leaf update difference
+    # ||delta_scan - delta_seq|| / ||delta_seq||:
+    # * leaves with a non-negligible update (||delta_seq|| >= lr in
+    #   aggregate) must agree to 1% — measured re-association noise on
+    #   this config sits at <= 1.2e-4, so a real semantic divergence
+    #   (wrong batch order, dropped update, stale batch_stats) blows
+    #   through by orders of magnitude;
+    # * noise-dominated leaves (a handful of decoder bias elements whose
+    #   total update is ~0.4*lr: each element IS the amplified rounding)
+    #   get an absolute 2-norm floor of 0.1*lr*sqrt(size) — measured
+    #   divergence 3.8e-5 vs floor 2e-4 for the worst leaf, still ~3x
+    #   tighter than the r5 per-element atol implied in 2-norm.
+    lr = optim_cfg.lr
+    for p0, a, b in zip(jax.tree_util.tree_leaves(params0),
+                        jax.tree_util.tree_leaves(state_a.params),
+                        jax.tree_util.tree_leaves(state_b.params)):
+        delta_seq = np.asarray(a, dtype=np.float64) - p0
+        delta_scan = np.asarray(b, dtype=np.float64) - p0
+        denom = np.linalg.norm(delta_seq)
+        diff = np.linalg.norm(delta_scan - delta_seq)
+        if denom >= lr:
+            assert diff / denom < 0.01, (diff, denom, diff / denom)
+        else:
+            assert diff < 0.1 * lr * np.sqrt(p0.size), (diff, denom, p0.size)
     assert int(state_b.step) == len(data)
 
 
