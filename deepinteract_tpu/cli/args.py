@@ -229,8 +229,20 @@ def add_logging_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--offline", action="store_true",
                    help="wandb offline mode (reference --offline flag)")
     g.add_argument("--profile_dir", type=str, default=None,
-                   help="capture a jax.profiler trace of the first train "
-                        "epoch into this directory")
+                   help="capture a phase-annotated jax.profiler trace of "
+                        "--profile_steps train dispatches (skipping "
+                        "dispatch 0, which is compile-dominated) into this "
+                        "directory")
+    g.add_argument("--profile_steps", type=int, default=3,
+                   help="train dispatches captured by --profile_dir")
+    g.add_argument("--heartbeat_seconds", type=float, default=0.0,
+                   help="write <ckpt_dir>/obs/heartbeat_p<i>.json (host id, "
+                        "current phase-span path, last-progress step/time) "
+                        "every N seconds; 0 disables. The multi-host "
+                        "'which host is stuck, and where' primitive")
+    g.add_argument("--no_span_log", action="store_true",
+                   help="disable the phase-span JSONL event log "
+                        "(<ckpt_dir>/obs/events.jsonl)")
     g.add_argument("--log_every", type=int, default=100)
 
 
@@ -310,6 +322,10 @@ def configs_from_args(
         nonfinite_guard=not getattr(args, "no_nonfinite_guard", False),
         max_bad_steps=getattr(args, "max_bad_steps", 10),
         preemption_guard=not getattr(args, "no_preemption_guard", False),
+        span_log=not getattr(args, "no_span_log", False),
+        heartbeat_seconds=getattr(args, "heartbeat_seconds", 0.0),
+        profile_dir=getattr(args, "profile_dir", None),
+        profile_steps=getattr(args, "profile_steps", 3),
     )
     return model_cfg, optim_cfg, loop_cfg
 

@@ -10,6 +10,7 @@ answers a JSON API::
 
     curl -X POST --data-binary @complex.npz http://127.0.0.1:8008/predict
     curl http://127.0.0.1:8008/stats
+    curl http://127.0.0.1:8008/metrics   # Prometheus text exposition
 
 SIGTERM drains in-flight requests and exits 0 (the PR-1 preemption
 discipline), so rolling restarts never drop accepted work.

@@ -9,7 +9,6 @@ with EarlyStopping + checkpointing -> fit -> final test pass.
 
 from __future__ import annotations
 
-import contextlib
 import sys
 
 from deepinteract_tpu.cli.args import (
@@ -172,18 +171,15 @@ def main(argv=None) -> int:
         fine_tune_from=args.ckpt_name if args.fine_tune else None,
     )
 
-    profile = contextlib.nullcontext()
-    if args.profile_dir:
-        import jax
-
-        profile = jax.profiler.trace(args.profile_dir)
+    # --profile_dir is handled inside the loop now (LoopConfig.profile_dir):
+    # the capture covers train dispatches 1..--profile_steps with phase-span
+    # annotations, instead of one unannotated whole-fit trace.
     from deepinteract_tpu.robustness.preemption import TrainingPreempted
 
     try:
-        with profile:
-            state, history = trainer.fit(
-                state, train_loader, val_data=val_loader, resume=args.resume
-            )
+        state, history = trainer.fit(
+            state, train_loader, val_data=val_loader, resume=args.resume
+        )
     except TrainingPreempted as exc:
         # Clean preemption exit (robustness/preemption.py): the last/
         # checkpoint is flushed; the scheduler restarts us with --resume.

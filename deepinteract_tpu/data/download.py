@@ -32,10 +32,18 @@ import urllib.request
 from typing import Optional
 from urllib.error import ContentTooShortError, HTTPError, URLError
 
+from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.robustness import faults
 from deepinteract_tpu.robustness.retry import retry
 
 logger = logging.getLogger(__name__)
+
+_FETCH_ATTEMPTS = obs_metrics.counter(
+    "di_download_fetch_attempts_total",
+    "Download attempts (including retried and faulted ones)")
+_REFETCHES = obs_metrics.counter(
+    "di_download_refetches_total",
+    "Existing destinations replaced by an overwrite refetch")
 
 # Reference-published artifacts (README.md:249-253; dataset READMEs).
 KNOWN_ARTIFACTS = {
@@ -75,6 +83,7 @@ def _is_transient(exc: BaseException) -> bool:
 )
 def _fetch(url: str, tmp: str, timeout: float) -> None:
     """One streaming download attempt into ``tmp`` (truncation-checked)."""
+    _FETCH_ATTEMPTS.inc()
     faults.maybe_raise(
         "download.fetch",
         lambda: URLError("injected transient network failure"),
@@ -125,6 +134,7 @@ def download_and_verify(url: str, dest: str, sha1: Optional[str] = None,
             if got != sha1:
                 raise ValueError(f"sha1 mismatch for {url}: {got} != {sha1}")
         if overwrite and os.path.exists(dest):
+            _REFETCHES.inc()
             logger.info("overwrite: replacing %s (failed or forced)", dest)
         shutil.move(tmp, dest)
     finally:

@@ -27,9 +27,16 @@ import numpy as np
 
 from deepinteract_tpu.data.graph import PairedComplex, pick_bucket, stack_complexes
 from deepinteract_tpu.data.io import to_paired_complex
+from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.robustness import faults
 
 logger = logging.getLogger(__name__)
+
+_BATCHES = obs_metrics.counter(
+    "di_data_batches_total", "Batches assembled by the bucketed loader")
+_SKIPPED = obs_metrics.counter(
+    "di_data_skipped_batches_total",
+    "Batches dropped by the corrupt-complex skip budget")
 
 
 def make_bucket_fn(pad_to_max_bucket: bool = False,
@@ -242,12 +249,14 @@ class BucketedLoader:
                 if skips_left <= 0:
                     raise
                 skips_left -= 1
+                _SKIPPED.inc()
                 logger.warning(
                     "skipping corrupt batch (bucket %sx%s, items %s): %s "
                     "— %d skip(s) left this epoch",
                     b1, b2, chunk, exc, skips_left,
                 )
                 continue
+            _BATCHES.inc()
             yield (batch, targets) if with_targets else batch
 
     def iter_epoch(self, epoch: int = 0, with_targets: bool = False) -> Iterator:

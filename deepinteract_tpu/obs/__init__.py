@@ -1,0 +1,37 @@
+"""Unified telemetry layer (stdlib-only).
+
+Three pillars, shared by training, serving, data loading, robustness and
+the native-kernel pipeline:
+
+* :mod:`deepinteract_tpu.obs.metrics` — a process-wide, thread-safe
+  registry of counters, gauges, and fixed-bucket histograms with label
+  support. All recording is host-side Python: nothing here ever runs
+  inside a jitted function or adds a device sync.
+* :mod:`deepinteract_tpu.obs.spans` — nested phase spans (epoch -> step
+  -> {data_wait, h2d, device_step, checkpoint, eval}) producing a JSONL
+  event log, optionally mirrored into ``jax.profiler`` trace annotations
+  so ``--profile_dir`` captures come out phase-labeled.
+* :mod:`deepinteract_tpu.obs.expfmt` — Prometheus text exposition of the
+  registry (served at ``GET /metrics`` by the serving HTTP server), plus
+  :mod:`deepinteract_tpu.obs.heartbeat` — a periodic liveness file with
+  host id, current span path, and last-progress timestamp (the
+  multi-host "which host is stuck, and where" debugging primitive).
+
+The package deliberately depends on nothing outside the standard library
+(``jax`` is imported lazily, and only when profiler annotations are
+enabled), so every layer of the system can import it unconditionally.
+"""
+
+from deepinteract_tpu.obs import expfmt, heartbeat, metrics, spans  # noqa: F401
+from deepinteract_tpu.obs.heartbeat import Heartbeat  # noqa: F401
+from deepinteract_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+)
+from deepinteract_tpu.obs.spans import read_events, span  # noqa: F401

@@ -1,0 +1,58 @@
+"""Prometheus text exposition (format 0.0.4) of the metrics registry.
+
+Renders every registered family as ``# HELP`` / ``# TYPE`` headers plus
+one sample line per (labels, value), with the standard escaping rules —
+the exact wire format a Prometheus scrape of ``GET /metrics`` expects.
+Stdlib-only by design (no prometheus_client dependency): the format is a
+few dozen lines and owning it keeps ``obs/`` importable everywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from deepinteract_tpu.obs.metrics import MetricsRegistry, get_registry
+
+# The content type Prometheus scrapers negotiate for the text format.
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label_value(text: str) -> str:
+    return (text.replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r"\""))
+
+
+def _fmt_value(value: float) -> str:
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def render(registry: Optional[MetricsRegistry] = None) -> str:
+    """The whole registry as Prometheus text; deterministic ordering
+    (families by name, series by label values) so scrapes diff cleanly."""
+    reg = registry if registry is not None else get_registry()
+    lines = []
+    for fam in reg.collect():
+        if fam.help:
+            lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for suffix, labels, value in fam.samples():
+            if labels:
+                body = ",".join(
+                    f'{k}="{_escape_label_value(str(v))}"'
+                    for k, v in labels.items())
+                lines.append(
+                    f"{fam.name}{suffix}{{{body}}} {_fmt_value(value)}")
+            else:
+                lines.append(f"{fam.name}{suffix} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"

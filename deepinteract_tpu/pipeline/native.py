@@ -27,10 +27,19 @@ from typing import Optional
 
 import numpy as np
 
+from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.robustness import faults
 from deepinteract_tpu.robustness.retry import retry
 
 logger = logging.getLogger(__name__)
+
+# Compile outcomes per process (retries of transient failures are counted
+# separately by di_retry_attempts_total{site="native.compile"}). A
+# "failure" here latches the NumPy fallback for the process lifetime, so
+# a fleet-wide failure rate > 0 means featurization is silently slower.
+_COMPILE_OUTCOMES = obs_metrics.counter(
+    "di_native_compile_total", "Native geometry-kernel compile outcomes",
+    labelnames=("outcome",))
 
 _SRC = os.path.join(os.path.dirname(__file__), "native", "geomfeats.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "native", "_build")
@@ -83,8 +92,10 @@ def _compile() -> bool:
     try:
         _run_compiler(cmd)
         os.replace(tmp_path, _LIB_PATH)
+        _COMPILE_OUTCOMES.inc(outcome="success")
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError) as exc:
+        _COMPILE_OUTCOMES.inc(outcome="failure")
         detail = exc
         if isinstance(exc, subprocess.CalledProcessError) and exc.stderr:
             detail = exc.stderr.decode(errors="replace").strip()[-500:]

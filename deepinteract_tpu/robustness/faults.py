@@ -35,6 +35,16 @@ import os
 import threading
 from typing import Dict, Optional, Set, Union
 
+from deepinteract_tpu.obs import metrics as obs_metrics
+
+# Chaos-visibility counter: every injected fault is also a telemetry
+# event, so a game day (or the chaos suite) can assert the faults it
+# configured actually fired — per site, from the same registry /metrics
+# serves.
+_INJECTED = obs_metrics.counter(
+    "di_faults_injected_total", "Faults injected by the active DI_FAULTS plan",
+    labelnames=("site",))
+
 _lock = threading.Lock()
 _plan: Optional[Dict[str, Set[int]]] = None  # None -> read env lazily
 _counts: Dict[str, int] = {}
@@ -120,7 +130,10 @@ def fire(site: str) -> bool:
         if site not in plan:
             return False
         _counts[site] = _counts.get(site, 0) + 1
-        return _counts[site] in plan[site]
+        fired = _counts[site] in plan[site]
+    if fired:
+        _INJECTED.inc(site=site)
+    return fired
 
 
 def call_count(site: str) -> int:

@@ -32,7 +32,17 @@ import random
 import time
 from typing import Callable, Optional, Tuple, Type
 
+from deepinteract_tpu.obs import metrics as obs_metrics
+
 logger = logging.getLogger(__name__)
+
+# One series per retry site (the decorator's label), counting FAILED
+# attempts that led to another try — a sustained nonzero rate here is the
+# earliest external-dependency degradation signal the process has.
+_RETRY_ATTEMPTS = obs_metrics.counter(
+    "di_retry_attempts_total",
+    "Failed attempts that were retried, per retry-decorated site",
+    labelnames=("site",))
 
 _ENV_OVERRIDES = {
     "max_attempts": ("DI_RETRY_MAX_ATTEMPTS", int),
@@ -107,6 +117,7 @@ def retry(
                             "%s: retry deadline (%.1fs) exhausted after "
                             "attempt %d: %s", name, limit, attempt + 1, exc)
                         raise
+                    _RETRY_ATTEMPTS.inc(site=name)
                     logger.warning(
                         "%s: attempt %d/%d failed (%s); retrying in %.2fs",
                         name, attempt + 1, attempts, exc, pause)

@@ -45,8 +45,30 @@ from deepinteract_tpu import constants
 from deepinteract_tpu.data.graph import stack_complexes
 from deepinteract_tpu.data.io import complex_lengths, to_paired_complex
 from deepinteract_tpu.data.loader import make_bucket_fn
+from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.serving.cache import ResultCache, content_hash
 from deepinteract_tpu.serving.scheduler import MicroBatchScheduler
+
+# Registry counters are PROCESS-wide (/metrics scope) and deliberately
+# parallel to the engine's per-instance attributes (/stats scope): two
+# engines in one process sum here but stay separate in their own stats(),
+# and a test's registry.reset() must not blank a live engine's /stats.
+_EXECUTED_REQUESTS = obs_metrics.counter(
+    "di_serving_executed_requests_total",
+    "Requests answered by a device dispatch (cache hits excluded)")
+_EXECUTED_BATCHES = obs_metrics.counter(
+    "di_serving_executed_batches_total", "Coalesced device dispatches")
+_PADDED_SLOTS = obs_metrics.counter(
+    "di_serving_padded_slots_total",
+    "Batch slots filled with padding rows (discarded work)")
+_CACHE_HITS = obs_metrics.counter(
+    "di_serving_result_cache_hits_total",
+    "Requests short-circuited by the result cache")
+_COMPILES = obs_metrics.counter(
+    "di_serving_compiles_total",
+    "Cold executable compiles (one per new bucket/batch key)")
+_COMPILE_SECONDS = obs_metrics.histogram(
+    "di_serving_compile_seconds", "Wall time of each cold compile")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -223,8 +245,10 @@ class InferenceEngine:
                 self.params, self.batch_stats, batch.graph1, batch.graph2
             ).compile()
             self._executables[key] = compiled
-            self._compile_seconds[self._key_label(key)] = (
-                time.perf_counter() - t0)
+            elapsed = time.perf_counter() - t0
+            self._compile_seconds[self._key_label(key)] = elapsed
+            _COMPILES.inc()
+            _COMPILE_SECONDS.observe(elapsed)
             return compiled
 
     @staticmethod
@@ -299,6 +323,7 @@ class InferenceEngine:
                                extra=("input_indep", self.cfg.input_indep))
             hit = self.cache.get(key)
             if hit is not None:
+                _CACHE_HITS.inc()
                 fut: Future = Future()
                 fut.set_result(dict(hit, cached=True))
                 return fut
@@ -335,6 +360,9 @@ class InferenceEngine:
         self._executed_batches += 1
         self._executed_requests += len(items)
         self._padded_slots += pad_slots
+        _EXECUTED_BATCHES.inc()
+        _EXECUTED_REQUESTS.inc(len(items))
+        _PADDED_SLOTS.inc(pad_slots)
         results = []
         for i, it in enumerate(items):
             depadded = probs[i, : it["n1"], : it["n2"]].copy()

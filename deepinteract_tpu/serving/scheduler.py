@@ -27,7 +27,15 @@ from collections import defaultdict, deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Hashable, List, Tuple
 
+from deepinteract_tpu.obs import metrics as obs_metrics
+
 logger = logging.getLogger(__name__)
+
+_FLUSHES = obs_metrics.counter(
+    "di_serving_flushes_total", "Coalesced groups handed to the flush fn")
+_GROUP_SIZE = obs_metrics.histogram(
+    "di_serving_coalesced_group_size", "Requests per coalesced flush",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
 
 
 class SchedulerClosed(RuntimeError):
@@ -159,6 +167,8 @@ class MicroBatchScheduler:
                 with self._cv:
                     self._flushes += 1
                     self._coalesced[len(group)] += 1
+                _FLUSHES.inc()
+                _GROUP_SIZE.observe(len(group))
             for (_, fut, _), result in zip(group, results):
                 if not fut.cancelled():
                     fut.set_result(result)

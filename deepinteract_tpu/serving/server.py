@@ -16,6 +16,10 @@ Endpoints:
 * ``GET /healthz`` — liveness + draining flag.
 * ``GET /stats`` — queue depth, per-bucket compile inventory, result-cache
   hit rate, and request-latency percentiles.
+* ``GET /metrics`` — the process-wide telemetry registry in Prometheus
+  text format (``obs/expfmt.py``). Latency percentiles in ``/stats`` are
+  derived from the same registry histogram the exposition serves, so the
+  two endpoints agree by construction.
 
 Shutdown: ``run()`` installs the PR-1 :class:`PreemptionGuard`; on
 SIGTERM/SIGINT the server stops accepting (``503`` on new predicts),
@@ -31,18 +35,26 @@ import json
 import logging
 import threading
 import time
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 from deepinteract_tpu.data.io import GRAPH_KEYS
+from deepinteract_tpu.obs import expfmt
+from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.robustness.preemption import PreemptionGuard
 from deepinteract_tpu.serving.engine import InferenceEngine
 from deepinteract_tpu.serving.scheduler import SchedulerClosed
 
 logger = logging.getLogger(__name__)
+
+# Every answered request, labeled by route and HTTP status. The 200-count
+# on /predict equals the latency histogram's count (both recorded on the
+# same success path) — the /metrics-vs-/stats agreement tests pin that.
+_REQUESTS = obs_metrics.counter(
+    "di_serving_requests_total", "HTTP requests answered",
+    labelnames=("endpoint", "status"))
 
 
 def raw_from_npz_bytes(body: bytes) -> Dict:
@@ -90,27 +102,33 @@ class _QuietThreadingHTTPServer(ThreadingHTTPServer):
 
 
 class _LatencyTracker:
-    """Rolling request-latency window -> percentiles for /stats."""
+    """Request-latency percentiles for /stats, backed by the process-wide
+    registry histogram (the same series ``/metrics`` exposes).
 
-    def __init__(self, window: int = 2048):
-        self._lat = deque(maxlen=window)
-        self._lock = threading.Lock()
+    Replaces the old rolling-sample window, which re-sorted a 2048-entry
+    Python list under the handler lock on EVERY /stats call; histogram
+    percentile estimation is O(buckets), recording is O(buckets) worst
+    case, and /stats can no longer disagree with the exposition. The
+    output keys are unchanged (count/p50_ms/p90_ms/p99_ms/max_ms)."""
+
+    def __init__(self):
+        self._hist = obs_metrics.histogram(
+            "di_serving_request_latency_seconds",
+            "End-to-end /predict latency (parse to response)")
 
     def record(self, seconds: float) -> None:
-        with self._lock:
-            self._lat.append(seconds)
+        self._hist.observe(seconds)
 
     def stats(self) -> Dict[str, Any]:
-        with self._lock:
-            lat = np.asarray(self._lat, dtype=np.float64)
-        if lat.size == 0:
+        count = self._hist.count()
+        if count == 0:
             return {"count": 0}
         return {
-            "count": int(lat.size),
-            "p50_ms": float(np.percentile(lat, 50) * 1e3),
-            "p90_ms": float(np.percentile(lat, 90) * 1e3),
-            "p99_ms": float(np.percentile(lat, 99) * 1e3),
-            "max_ms": float(lat.max() * 1e3),
+            "count": count,
+            "p50_ms": self._hist.percentile(50) * 1e3,
+            "p90_ms": self._hist.percentile(90) * 1e3,
+            "p99_ms": self._hist.percentile(99) * 1e3,
+            "max_ms": self._hist.max_value() * 1e3,
         }
 
 
@@ -133,13 +151,27 @@ class ServingServer:
             def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
                 logger.debug("http: " + fmt, *args)
 
-            def _send_json(self, code: int, payload: Dict) -> None:
-                body = json.dumps(payload).encode()
+            def _send_body(self, code: int, body: bytes,
+                           content_type: str) -> None:
+                # Counted BEFORE the body write: a client that disconnects
+                # mid-response must not make the request vanish from the
+                # counter while the latency histogram already saw it (the
+                # /stats-vs-/metrics agreement depends on it). Route label
+                # is the matched route ("other" for 404s), not the raw
+                # path — unknown client paths must not mint unbounded
+                # label values in the registry.
+                endpoint = self.path if self.path in (
+                    "/predict", "/healthz", "/stats", "/metrics") else "other"
+                _REQUESTS.inc(endpoint=endpoint, status=str(code))
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_json(self, code: int, payload: Dict) -> None:
+                self._send_body(code, json.dumps(payload).encode(),
+                                "application/json")
 
             def do_GET(self):  # noqa: N802 - stdlib name
                 if self.path == "/healthz":
@@ -150,6 +182,9 @@ class ServingServer:
                     })
                 elif self.path == "/stats":
                     self._send_json(200, server.stats())
+                elif self.path == "/metrics":
+                    self._send_body(200, server.metrics_text().encode(),
+                                    expfmt.CONTENT_TYPE)
                 else:
                     self._send_json(404, {"error": f"no route {self.path}"})
 
@@ -240,7 +275,7 @@ class ServingServer:
             self.serve_background()
             host, port = self.address
             logger.info("serving on http://%s:%d (POST /predict, "
-                        "GET /healthz, GET /stats)", host, port)
+                        "GET /healthz, GET /stats, GET /metrics)", host, port)
             while not guard.requested:
                 time.sleep(poll_seconds)
             logger.warning("drain requested (%s): refusing new requests, "
@@ -261,3 +296,29 @@ class ServingServer:
             "latency": self.latency.stats(),
             "draining": self._draining.is_set(),
         }
+
+    def metrics_text(self) -> str:
+        """Prometheus text for ``GET /metrics``: point-in-time gauges
+        (queue depth, compile inventory, cache hit rate) are refreshed
+        from the engine at scrape time, then the whole process registry —
+        including training/data/robustness families when co-resident —
+        is rendered."""
+        eng = self.engine.stats()
+        g = obs_metrics.gauge
+        g("di_serving_queue_depth",
+          "Requests pending in the micro-batch scheduler").set(
+            eng["scheduler"]["queue_depth"])
+        g("di_serving_compiled_executables",
+          "Entries in the shape-bucketed compile cache").set(
+            eng["num_compiled_executables"])
+        g("di_serving_result_cache_size",
+          "Entries in the LRU result cache").set(eng["result_cache"]["size"])
+        g("di_serving_result_cache_hit_rate",
+          "Result-cache hit rate since startup").set(
+            eng["result_cache"]["hit_rate"])
+        g("di_serving_uptime_seconds",
+          "Engine uptime").set(eng["uptime_seconds"])
+        g("di_serving_draining",
+          "1 while the server refuses new work").set(
+            float(self._draining.is_set()))
+        return expfmt.render()

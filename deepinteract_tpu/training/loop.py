@@ -35,6 +35,8 @@ import numpy as np
 
 from deepinteract_tpu.data.graph import PairedComplex
 from deepinteract_tpu.models.model import DeepInteract
+from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.obs import spans as obs_spans
 from deepinteract_tpu.parallel.multihost import (
     assert_same_across_hosts,
     host_local_array,
@@ -53,6 +55,20 @@ from deepinteract_tpu.training.optim import OptimConfig
 from deepinteract_tpu.training.steps import TrainState, create_train_state, eval_step, train_step
 
 DataSource = Union[Sequence[PairedComplex], Callable[[int], Iterable[PairedComplex]]]
+
+# Host-side training telemetry (obs/metrics.py): recorded from the metric
+# fetch path, never inside a jitted function — the trace-count and
+# scan-parity tests pin that no new device syncs ride along.
+_STEPS_TOTAL = obs_metrics.counter(
+    "di_train_steps_total", "Train steps whose metrics reached the host")
+_SKIPPED_TOTAL = obs_metrics.counter(
+    "di_train_skipped_steps_total",
+    "Optimizer updates skipped by the non-finite guard")
+_NONFINITE_ABORTS = obs_metrics.counter(
+    "di_train_nonfinite_aborts_total",
+    "Runs aborted after max_bad_steps consecutive non-finite steps")
+_EPOCHS_TOTAL = obs_metrics.counter(
+    "di_train_epochs_total", "Completed training epochs")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,6 +135,26 @@ class LoopConfig:
     # copy exhausts device memory, the loop logs a downgrade and falls
     # back to synchronous saves instead of failing the run.
     async_checkpoint: bool = True
+    # -- telemetry (obs/) --------------------------------------------------
+    # Write phase-span events (epoch -> step -> {data_wait, h2d,
+    # device_step} plus checkpoint/eval) to <ckpt_dir>/obs/events.jsonl.
+    # Only engages when a run dir exists (ckpt_dir set, primary host) and
+    # no sink was configured explicitly; the span machinery itself is
+    # always on (it feeds the step-time decomposition) and costs two
+    # perf_counter calls per phase.
+    span_log: bool = True
+    # Write a liveness heartbeat JSON (<ckpt_dir>/obs/heartbeat.json, host
+    # id + current span path + last-progress step/timestamp) every this
+    # many seconds; 0 disables. The multi-host "which host is stuck, and
+    # where" primitive — each host writes its own file.
+    heartbeat_seconds: float = 0.0
+    # Capture a jax.profiler trace of train dispatches [1, 1+profile_steps)
+    # of the first epoch into profile_dir (dispatch 0 is skipped: it is
+    # dominated by compile). Spans emit TraceAnnotation/
+    # StepTraceAnnotation while the capture runs, so the trace comes out
+    # phase-labeled. None disables.
+    profile_dir: Optional[str] = None
+    profile_steps: int = 3
 
 
 class EarlyStopping:
@@ -208,6 +244,25 @@ class Trainer:
         self.mesh = mesh
         self.log = log_fn
         self.metric_writer = metric_writer
+        # Epoch scalars route through a fan-out writer so the telemetry
+        # registry always mirrors whatever external sink (wandb/TB) is
+        # configured — identical call sequence for that sink either way.
+        from deepinteract_tpu.training.wandb_logger import (
+            FanoutWriter,
+            RegistryWriter,
+        )
+
+        self._scalar_writer = FanoutWriter([metric_writer, RegistryWriter()])
+        self._heartbeat = None
+        # --profile_dir state: capture profile_steps dispatches starting at
+        # the run's SECOND train dispatch (the first is compile-dominated).
+        # The dispatch counter is run-global, not per-epoch, so one-
+        # dispatch-per-epoch runs still open the window at epoch 1.
+        self._profile_active = False
+        self._profile_started = False
+        self._profile_done = loop_cfg.profile_dir is None
+        self._profile_remaining = 0
+        self._dispatch_count = 0
         # Active PreemptionGuard while fit() runs (robustness/preemption
         # .py); _run_train_epoch and evaluate poll it at dispatch
         # boundaries. None outside fit or when preemption_guard is off.
@@ -588,6 +643,32 @@ class Trainer:
                 lambda tr=tree, sn=step_no, me=dict(metrics):
                     ckpt.save(sn, _fetch_tree(tr), me))
 
+        # Telemetry plumbing (obs/): span JSONL under the run dir, plus the
+        # optional liveness heartbeat. Both are host-side only, and both
+        # start HERE — immediately before the try/finally that tears them
+        # down — so a failed resume/saver setup above cannot leak a live
+        # heartbeat thread (a fresh-looking file for a dead run) or an
+        # open sink. A sink this fit auto-configures is ALSO closed by
+        # this fit (own_span_sink), so a second fit in the same process
+        # opens its own run's log instead of appending to the first's; an
+        # explicitly pre-configured sink is left untouched.
+        own_span_sink = False
+        if (cfg.span_log and cfg.ckpt_dir and is_primary_host()
+                and not obs_spans.configured()):
+            obs_spans.configure(
+                os.path.join(cfg.ckpt_dir, "obs", "events.jsonl"))
+            own_span_sink = True
+        if cfg.heartbeat_seconds > 0:
+            from deepinteract_tpu.obs.heartbeat import Heartbeat
+
+            hb_dir = cfg.ckpt_dir or cfg.diagnostics_dir or "."
+            self._heartbeat = Heartbeat(
+                os.path.join(hb_dir, "obs",
+                             f"heartbeat_p{jax.process_index()}.json"),
+                interval_s=cfg.heartbeat_seconds,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            ).start()
         # Cooperative preemption (robustness/preemption.py): entered
         # manually (not `with`) to keep the epoch loop's indentation; the
         # finally below always restores the previous signal handlers.
@@ -596,9 +677,15 @@ class Trainer:
         if preempt is not None:
             preempt.__enter__()
         abort_exc = None
+        epoch_span = None
         try:
           for epoch in range(start_epoch, epochs):
             self._check_preempt(epoch_boundary=True)
+            # Managed manually (not `with`) to keep the epoch body's
+            # indentation; Span.__exit__ is idempotent, and the finally
+            # below closes it on every abnormal exit path.
+            epoch_span = obs_spans.span("epoch", epoch=epoch)
+            epoch_span.__enter__()
             t_epoch = time.time()
             train_losses = []
             epoch_stats: Dict[str, float] = {}
@@ -628,7 +715,9 @@ class Trainer:
                 epoch_metrics["train_skipped_steps"] = float(
                     epoch_stats.get("skipped_steps", 0))
             if val_data is not None:
-                epoch_metrics.update(self.evaluate(state, val_data, stage="val"))
+                with obs_spans.span("eval", epoch=epoch):
+                    epoch_metrics.update(
+                        self.evaluate(state, val_data, stage="val"))
                 epoch_metrics["val_eval_seconds"] = time.time() - t_train_done
                 if (
                     cfg.viz_every_n_epochs
@@ -671,8 +760,30 @@ class Trainer:
                         lambda a, b: a + (b - a) / swa_count, swa_params, p
                     )
 
+            ckpt_seconds = 0.0
             if ckpt is not None:
-                submit_save(epoch + 1, state, epoch_metrics)
+                with obs_spans.span("checkpoint", epoch=epoch) as ckpt_span:
+                    submit_save(epoch + 1, state, epoch_metrics)
+                ckpt_seconds = ckpt_span.dur_s
+
+            # Per-epoch step-time decomposition: where the wall clock went
+            # (host-side timers only — data_wait/h2d/device come from
+            # _run_train_epoch via epoch_stats, checkpoint is the blocking
+            # part of the save above). Logged, kept in history, and
+            # persisted in the trainer_state.json sidecar as `telemetry`.
+            telemetry = self._epoch_telemetry(
+                epoch_stats, ckpt_seconds,
+                eval_s=epoch_metrics.get("val_eval_seconds", 0.0),
+                epoch_s=time.time() - t_epoch)
+            epoch_metrics.update(telemetry)
+            _EPOCHS_TOTAL.inc()
+            self.log(
+                f"epoch {epoch} telemetry: "
+                f"data_wait={telemetry['tele_data_wait_frac']:.1%} "
+                f"device={telemetry['tele_device_frac']:.1%} "
+                f"checkpoint={telemetry['tele_checkpoint_frac']:.1%} "
+                f"eval={telemetry['tele_eval_frac']:.1%}"
+            )
 
             tracked = epoch_metrics.get(cfg.metric_to_track, float("nan"))
             if val_data is not None and stopper.update(tracked):
@@ -688,10 +799,12 @@ class Trainer:
                     "epoch": epoch + 1,
                     "stopper_best": stopper.best,
                     "stopper_stale": stopper.stale_epochs,
+                    "telemetry": telemetry,
                 })
             if cfg.max_time_seconds and (time.time() - t_start) > cfg.max_time_seconds:
                 self.log("max_time reached; stopping")
                 stop = True
+            epoch_span.__exit__(None, None, None)
             if stop:
                 break
 
@@ -714,6 +827,14 @@ class Trainer:
                 if preempt is not None:
                     preempt.__exit__(None, None, None)
                 self._preempt = None
+                self._stop_profile()
+                if epoch_span is not None:
+                    epoch_span.__exit__(None, None, None)
+                if own_span_sink:
+                    obs_spans.close()
+                if self._heartbeat is not None:
+                    self._heartbeat.stop()
+                    self._heartbeat = None
 
         if abort_exc is not None:
             if ckpt is not None:
@@ -754,6 +875,66 @@ class Trainer:
 
     # -- internals ---------------------------------------------------------
 
+    @staticmethod
+    def _epoch_telemetry(epoch_stats: Dict[str, float], ckpt_s: float,
+                         eval_s: float, epoch_s: float) -> Dict[str, float]:
+        """Flat float dict (history/metric-writer friendly): absolute
+        seconds per phase plus fractions of the epoch wall. The phases are
+        not exhaustive (SWA/viz/logging live in the remainder), so the
+        fractions answer "what dominates", not "what sums to one"."""
+        wall = max(epoch_s, 1e-9)
+        data_s = float(epoch_stats.get("data_wait_s", 0.0))
+        h2d_s = float(epoch_stats.get("h2d_s", 0.0))
+        device_s = float(epoch_stats.get("device_s", 0.0))
+        return {
+            "tele_data_wait_s": data_s,
+            "tele_h2d_s": h2d_s,
+            "tele_device_s": device_s,
+            "tele_checkpoint_s": float(ckpt_s),
+            "tele_eval_s": float(eval_s),
+            "tele_data_wait_frac": data_s / wall,
+            "tele_device_frac": device_s / wall,
+            "tele_checkpoint_frac": float(ckpt_s) / wall,
+            "tele_eval_frac": float(eval_s) / wall,
+        }
+
+    def _profile_tick(self) -> None:
+        """--profile_dir window control, called before every train
+        dispatch: start the jax.profiler capture at the run's second
+        dispatch (the first is compile-dominated) and stop it after
+        LoopConfig.profile_steps dispatches. Span profiler annotations are
+        enabled for the window, so the trace comes out phase-labeled."""
+        if self._profile_done:
+            return
+        if not self._profile_active:
+            if self._dispatch_count >= 1:
+                jax.profiler.start_trace(self.cfg.profile_dir)
+                obs_spans.set_profiler_annotations(True)
+                self._profile_active = True
+                self._profile_started = True
+                self._profile_remaining = max(1, self.cfg.profile_steps)
+                self.log(
+                    f"profiling {self._profile_remaining} train dispatch(es) "
+                    f"into {self.cfg.profile_dir}")
+            return
+        self._profile_remaining -= 1
+        if self._profile_remaining <= 0:
+            self._stop_profile()
+
+    def _stop_profile(self) -> None:
+        """Idempotent capture stop (also the fit-end/abort safety net, so
+        a short run never leaves a trace capture dangling)."""
+        if self._profile_active:
+            obs_spans.set_profiler_annotations(False)
+            jax.profiler.stop_trace()
+            self._profile_active = False
+        if (self.cfg.profile_dir and not self._profile_started
+                and not self._profile_done):
+            self.log(
+                f"profile_dir={self.cfg.profile_dir}: the run ended before "
+                "its second train dispatch — nothing was captured")
+        self._profile_done = True
+
     def _run_train_epoch(self, state: TrainState, train_data: DataSource,
                          epoch: int, train_losses: list,
                          epoch_stats: Optional[Dict[str, float]] = None) -> TrainState:
@@ -777,6 +958,13 @@ class Trainer:
         step_idx = 0
         stats = epoch_stats if epoch_stats is not None else {}
         stats.setdefault("skipped_steps", 0)
+        # Phase accumulators for the epoch's step-time decomposition
+        # (host wall clock only; dispatch is async, so "device_s" counts
+        # time the HOST spent dispatching + blocked fetching metrics —
+        # exactly the existing differenced protocol, no new syncs).
+        stats.setdefault("data_wait_s", 0.0)
+        stats.setdefault("h2d_s", 0.0)
+        stats.setdefault("device_s", 0.0)
         # Abort-diagnostics context: a short host-side metric history plus
         # the last two dispatched runs' host batches (summarized lazily —
         # only on abort — so steady state pays just two references).
@@ -807,6 +995,7 @@ class Trainer:
             if is_primary_host():
                 path = dump_diagnostics(
                     cfg.diagnostics_dir or cfg.ckpt_dir or ".", payload)
+            _NONFINITE_ABORTS.inc()
             raise NonFiniteTrainingError(
                 f"aborting: {consecutive} consecutive non-finite train steps "
                 f"(epoch {epoch}, step {step_idx}, max_bad_steps="
@@ -818,6 +1007,9 @@ class Trainer:
         def log_step(metrics):
             nonlocal step_idx
             step_idx += 1
+            _STEPS_TOTAL.inc()
+            if self._heartbeat is not None:
+                self._heartbeat.progress(step=step_idx, epoch=epoch)
             # host_local_array: multi-host losses are replicated global
             # arrays that plain float() cannot read.
             loss = float(host_local_array(metrics["loss"]))
@@ -827,6 +1019,7 @@ class Trainer:
             if "bad_step" in metrics:
                 if float(host_local_array(metrics["bad_step"])) > 0:
                     stats["skipped_steps"] += 1
+                    _SKIPPED_TOTAL.inc()
                     self.log(
                         f"epoch {epoch} step {step_idx}: non-finite "
                         f"loss/grads (loss={loss}) — optimizer update "
@@ -862,10 +1055,14 @@ class Trainer:
             # device round trip PER MICROBATCH, which at K=8 through a
             # remote-device tunnel dominates the logging path
             # (measured, tools/sustained_train.py r4).
+            t0 = time.perf_counter()
             stacked_host = {
                 k: np.asarray(host_local_array(v))
                 for k, v in stacked.items()
             }
+            # The fetch blocks until the dispatch's device work is done,
+            # so it belongs to the device share of the decomposition.
+            stats["device_s"] += time.perf_counter() - t0
             for j in range(n):
                 log_step({k: v[j] for k, v in stacked_host.items()})
 
@@ -879,7 +1076,21 @@ class Trainer:
                     self._preempt.request("injected SIGTERM (fault plan)")
                 yield faults.maybe_poison("train.nan_batch", b)
 
-        for run in _shape_runs(instrumented(_iter_data(train_data, epoch)), k):
+        # data_wait: host wall time blocked pulling the next same-shape run
+        # out of the (possibly prefetching) loader — the input-bound-loop
+        # detector. Measured around the iterator's next() because the wait
+        # happens inside generator suspension where a `with` cannot reach;
+        # each wait is also emitted as a leaf span event.
+        run_iter = iter(
+            _shape_runs(instrumented(_iter_data(train_data, epoch)), k))
+        while True:
+            t_wait = time.perf_counter()
+            run = next(run_iter, None)
+            waited = time.perf_counter() - t_wait
+            stats["data_wait_s"] += waited
+            if run is None:
+                break
+            obs_spans.emit("data_wait", waited, n=len(run))
             self._check_preempt()
             recent_runs.append(run)
             if len(run) < max(k, 2):
@@ -887,8 +1098,20 @@ class Trainer:
                     flush(pending)
                     pending = None
                 for b in run:
-                    state, metrics = self._train_step(state, self._device_batch(b))
-                    log_step(metrics)
+                    # Each batch here is its OWN device dispatch, so the
+                    # profile window and step numbering advance per batch
+                    # (the scanned branch advances once per scan).
+                    self._profile_tick()
+                    with obs_spans.span("step",
+                                        step_num=self._dispatch_count):
+                        with obs_spans.span("h2d") as h2d_span:
+                            batch = self._device_batch(b)
+                        with obs_spans.span("device_step") as dev_span:
+                            state, metrics = self._train_step(state, batch)
+                            log_step(metrics)
+                    stats["h2d_s"] += h2d_span.dur_s
+                    stats["device_s"] += dev_span.dur_s
+                    self._dispatch_count += 1
             else:
                 # Buffered batches stay on host until stacked here; ONE
                 # placement per dispatch (device_put-ing each batch first
@@ -896,18 +1119,29 @@ class Trainer:
                 # np.stack). Multi-host needs the explicit global-array
                 # construction in _device_stacked; single-device runs
                 # take the packed upload (one buffer per dtype).
-                if self.mesh is None:
-                    from deepinteract_tpu.training.steps import pack_tree
+                self._profile_tick()
+                with obs_spans.span("step", step_num=self._dispatch_count,
+                                    n=len(run)):
+                    with obs_spans.span("h2d") as h2d_span:
+                        if self.mesh is None:
+                            from deepinteract_tpu.training.steps import pack_tree
 
-                    buffers, spec = pack_tree(stack_microbatches(run))
-                    state, stacked = self._multi_step_packed(
-                        state, buffers, spec)
-                else:
-                    state, stacked = self._multi_step(
-                        state, self._device_stacked(stack_microbatches(run)))
+                            buffers, spec = pack_tree(stack_microbatches(run))
+                        else:
+                            placed = self._device_stacked(
+                                stack_microbatches(run))
+                    with obs_spans.span("device_step") as dev_span:
+                        if self.mesh is None:
+                            state, stacked = self._multi_step_packed(
+                                state, buffers, spec)
+                        else:
+                            state, stacked = self._multi_step(state, placed)
+                stats["h2d_s"] += h2d_span.dur_s
+                stats["device_s"] += dev_span.dur_s
                 if pending is not None:
                     flush(pending)  # N-1's fetch, after N's async dispatch
                 pending = (stacked, len(run))
+                self._dispatch_count += 1
         if pending is not None:
             flush(pending)
         return state
@@ -977,11 +1211,12 @@ class Trainer:
                                      dataformats="HWC")
 
     def _write_metrics(self, epoch: int, metrics: Dict[str, float]) -> None:
-        if self.metric_writer is None:
-            return
+        # Fan-out: the configured writer (if any) plus the registry sink,
+        # so /metrics-style exposition of a co-resident process sees the
+        # last epoch's scalars with zero extra configuration.
         for k, v in metrics.items():
             if isinstance(v, (int, float)) and not math.isnan(float(v)):
-                self.metric_writer.add_scalar(k, float(v), epoch)
+                self._scalar_writer.add_scalar(k, float(v), epoch)
 
 
 def _is_resource_exhausted(exc: Exception) -> bool:

@@ -56,9 +56,34 @@ class WandbWriter:
         self.run.finish()
 
 
+class RegistryWriter:
+    """Writer-protocol sink over the process-wide telemetry registry
+    (obs/metrics.py): every scalar lands in the
+    ``di_train_metric{metric=...}`` gauge, so a co-resident exposition
+    (or a test) can read the trainer's latest epoch metrics without any
+    external logging backend. Stacks under :class:`FanoutWriter` next to
+    wandb/TensorBoard; images and artifacts are not mirrored."""
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        from deepinteract_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.gauge(
+            "di_train_metric", "Last logged value of each trainer scalar",
+            labelnames=("metric",),
+        ).set(float(value), metric=tag)
+        obs_metrics.gauge(
+            "di_train_last_epoch", "Epoch of the last logged scalar",
+        ).set(float(step))
+
+    def add_image(self, tag, img, step, dataformats="HWC") -> None:
+        pass  # gauges cannot carry images; wandb/TB sinks handle these
+
+
 class FanoutWriter:
-    """Broadcast writer calls to several writers (e.g. TB + W&B, the
-    reference's logger list)."""
+    """Broadcast writer calls to several writers (e.g. TB + W&B + the
+    registry sink, the reference's logger list). ``None`` entries are
+    dropped, so a single configured sink sees the identical call
+    sequence it would alone."""
 
     def __init__(self, writers):
         self.writers = [w for w in writers if w is not None]

@@ -779,3 +779,88 @@ def test_non_oom_snapshot_error_still_raises(toy_data, tmp_path):
             trainer.fit(state, toy_data)
     finally:
         faults_mod.maybe_raise = original_maybe_raise
+
+
+# ---------------------------------------------------------------------------
+# obs/ registry integration: injected faults are visible as telemetry
+# (ISSUE-3 chaos markers). Counters are process-global, so every assert
+# is a delta against the value captured before the fault plan fires.
+
+
+def _registry():
+    from deepinteract_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.get_registry()
+
+
+def test_injected_download_faults_increment_registry_counters(
+        tmp_path, no_delays):
+    reg = _registry()
+    injected = reg.counter("di_faults_injected_total", labelnames=("site",))
+    retries = reg.counter("di_retry_attempts_total", labelnames=("site",))
+    attempts = reg.counter("di_download_fetch_attempts_total")
+    before = (injected.value(site="download.fetch"),
+              retries.value(site="download.fetch"), attempts.value())
+
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload")
+    from deepinteract_tpu.data.download import download_and_verify
+
+    faults.configure({"download.fetch": 2})  # first two attempts fault
+    download_and_verify(_file_url(src), str(tmp_path / "dest.bin"))
+
+    assert injected.value(site="download.fetch") == before[0] + 2
+    assert retries.value(site="download.fetch") == before[1] + 2
+    assert attempts.value() == before[2] + 3  # 2 faulted + 1 success
+
+
+def test_overwrite_refetch_increments_registry_counter(tmp_path):
+    reg = _registry()
+    refetches = reg.counter("di_download_refetches_total")
+    before = refetches.value()
+
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"fresh artifact")
+    dest = tmp_path / "dest.bin"
+    dest.write_bytes(b"stale artifact")
+    from deepinteract_tpu.data.download import download_and_verify
+
+    download_and_verify(_file_url(src), str(dest), overwrite=True)
+    assert refetches.value() == before + 1
+    assert dest.read_bytes() == b"fresh artifact"
+
+
+def test_nonfinite_skips_increment_registry_counters(toy_data):
+    reg = _registry()
+    skipped = reg.counter("di_train_skipped_steps_total")
+    steps = reg.counter("di_train_steps_total")
+    before = (skipped.value(), steps.value())
+
+    faults.configure({"train.nan_batch": [2]})
+    trainer = _toy_trainer(num_epochs=1)
+    state = trainer.init_state(toy_data[0])
+    trainer.fit(state, toy_data)
+
+    assert skipped.value() == before[0] + 1
+    assert steps.value() == before[1] + 4  # all 4 steps reached the host
+    # The poisoned batch is also visible as an injected fault.
+    assert reg.counter("di_faults_injected_total", labelnames=("site",)
+                       ).value(site="train.nan_batch") >= 1
+
+
+def test_loader_skip_budget_increments_registry_counter(toy_data):
+    reg = _registry()
+    skipped_batches = reg.counter("di_data_skipped_batches_total")
+    before = skipped_batches.value()
+
+    from deepinteract_tpu.data.loader import BucketedLoader, InMemoryDataset
+    from tests.test_data_layer import make_raw_complex
+
+    raws = [make_raw_complex(10, 8, np.random.default_rng(i), knn=4)
+            for i in range(3)]
+    loader = BucketedLoader(InMemoryDataset(raws), batch_size=1,
+                            skip_budget=1, prefetch=0)
+    faults.configure({"loader.batch": [2]})
+    batches = list(loader.iter_epoch(0))
+    assert len(batches) == 2  # one batch dropped within budget
+    assert skipped_batches.value() == before + 1

@@ -1,0 +1,36 @@
+"""Fast-tier wiring of tools/check_no_print.py: the library must stay
+free of bare print() calls (logging / obs registry only; cli/ and
+bench.py are the sanctioned stdout surfaces)."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_no_bare_print_outside_cli():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_no_print.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"bare print() calls crept into the library:\n{proc.stdout}"
+        f"{proc.stderr}")
+
+
+def test_checker_flags_a_real_violation(tmp_path):
+    """The check must actually detect — an always-green linter is worse
+    than none. Name references (log_fn=print) must NOT count."""
+    pkg = tmp_path / "pkg"
+    (pkg / "cli").mkdir(parents=True)
+    (pkg / "core.py").write_text(
+        "def f(log_fn=print):\n    print('leak')\n")
+    (pkg / "cli" / "main.py").write_text("print('allowed')\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_no_print.py"),
+         "--root", str(pkg)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "core.py:2" in proc.stdout
+    assert "core.py:1" not in proc.stdout  # default-arg reference is fine
+    assert "main.py" not in proc.stdout  # cli/ exempt

@@ -1,0 +1,375 @@
+"""Telemetry-layer tests: registry semantics, Prometheus exposition,
+span JSONL round trip, heartbeats, and the Trainer's per-epoch step-time
+decomposition (sidecar `telemetry` + span events).
+
+The trainer integration reuses the chaos suite's toy-model pattern so
+the whole file stays in the quick tier.
+"""
+
+import json
+import math
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.obs import expfmt, heartbeat, spans
+from deepinteract_tpu.obs import metrics as obs_metrics
+
+# ---------------------------------------------------------------------------
+# metrics.py
+
+
+def test_counter_gauge_basics_and_labels():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("t_events_total", "events", labelnames=("kind",))
+    c.inc(kind="a")
+    c.inc(2, kind="a")
+    c.inc(kind="b")
+    assert c.value(kind="a") == 3.0 and c.value(kind="b") == 1.0
+    with pytest.raises(obs_metrics.MetricError):
+        c.inc(-1, kind="a")  # counters are monotone
+    with pytest.raises(obs_metrics.MetricError):
+        c.inc(wrong="a")  # label names are fixed per family
+    g = reg.gauge("t_depth")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3.0
+
+
+def test_registration_is_idempotent_but_typed():
+    reg = obs_metrics.MetricsRegistry()
+    a = reg.counter("t_x_total", "first")
+    b = reg.counter("t_x_total", "second help ignored")
+    assert a is b  # same family object on repeat registration
+    with pytest.raises(obs_metrics.MetricError):
+        reg.gauge("t_x_total")  # type mismatch
+    with pytest.raises(obs_metrics.MetricError):
+        reg.counter("t_x_total", labelnames=("k",))  # label mismatch
+
+
+def test_histogram_percentiles_and_max():
+    reg = obs_metrics.MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.total() == pytest.approx(0.605)
+    assert h.max_value() == 0.5
+    # p50 lands in the (0.01, 0.1] bucket, p99 in (0.1, 1.0].
+    assert 0.01 < h.percentile(50) <= 0.1
+    assert 0.1 < h.percentile(99) <= 0.5
+    assert h.percentile(100) == 0.5
+    # Overflow observations interpolate toward the observed max, not inf.
+    h.observe(7.0)
+    assert h.percentile(99) <= 7.0 and math.isfinite(h.percentile(99))
+    assert h.percentile(0) == 0.0 or h.percentile(0) <= 0.01
+
+
+def test_histogram_empty_and_bad_buckets():
+    reg = obs_metrics.MetricsRegistry()
+    h = reg.histogram("t_empty_seconds", buckets=(1.0, 2.0))
+    assert h.count() == 0 and h.percentile(50) == 0.0 and h.max_value() == 0.0
+    with pytest.raises(obs_metrics.MetricError):
+        reg.histogram("t_bad", buckets=(2.0, 1.0))
+    with pytest.raises(obs_metrics.MetricError):
+        reg.histogram("t_inf", buckets=(1.0, float("inf")))
+
+
+def test_registry_reset_keeps_family_identity():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("t_keep_total")
+    c.inc(5)
+    reg.reset()
+    assert c.value() == 0.0
+    assert reg.counter("t_keep_total") is c
+
+
+def test_counter_thread_safety():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("t_race_total")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000.0
+
+
+# ---------------------------------------------------------------------------
+# expfmt.py
+
+# One Prometheus text sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+
+
+def parse_prometheus_text(text):
+    """Minimal format validator + sample extractor: returns
+    {(name, frozen_labels): float}. Raises on malformed lines."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            if line.startswith("#") and not line.startswith(("# HELP", "# TYPE")):
+                raise ValueError(f"bad comment: {line!r}")
+            continue
+        if not _SAMPLE_RE.match(line):
+            raise ValueError(f"malformed sample line: {line!r}")
+        name_part, value = line.rsplit(" ", 1)
+        labels = {}
+        if "{" in name_part:
+            name, rest = name_part.split("{", 1)
+            body = rest.rstrip("}")
+            for item in filter(None, re.split(r'",\s*', body)):
+                k, v = item.split("=", 1)
+                labels[k] = v.strip('"')
+        else:
+            name = name_part
+        samples[(name, frozenset(labels.items()))] = float(value)
+    return samples
+
+
+def test_expfmt_renders_all_kinds_with_escaping():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("t_reqs_total", "requests", labelnames=("path",)).inc(
+        path='we"ird\npath\\x')
+    reg.gauge("t_gauge", "a gauge").set(2.5)
+    h = reg.histogram("t_h_seconds", "hist", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(3.0)
+    text = expfmt.render(reg)
+    assert "# TYPE t_reqs_total counter" in text
+    assert "# TYPE t_gauge gauge" in text
+    assert "# TYPE t_h_seconds histogram" in text
+    samples = parse_prometheus_text(text)  # must parse cleanly
+    # Cumulative buckets + +Inf + sum/count.
+    assert samples[("t_h_seconds_bucket", frozenset([("le", "0.1")]))] == 1
+    assert samples[("t_h_seconds_bucket", frozenset([("le", "+Inf")]))] == 2
+    assert samples[("t_h_seconds_count", frozenset())] == 2
+    assert samples[("t_h_seconds_sum", frozenset())] == pytest.approx(3.05)
+    # The escaped label survives the round trip structurally (one sample).
+    assert any(n == "t_reqs_total" for n, _ in samples)
+
+
+# ---------------------------------------------------------------------------
+# spans.py
+
+
+def test_span_nesting_round_trip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    spans.configure(path)
+    try:
+        with spans.span("epoch", epoch=0):
+            assert spans.current_path() == "epoch"
+            with spans.span("step"):
+                with spans.span("device_step") as dev:
+                    time.sleep(0.01)
+                assert dev.dur_s >= 0.005
+            spans.emit("data_wait", 0.25, n=4)
+    finally:
+        spans.close()
+    events = spans.read_events(path)
+    by_name = {e["name"]: e for e in events}
+    assert by_name["device_step"]["path"] == "epoch/step/device_step"
+    assert by_name["step"]["path"] == "epoch/step"
+    assert by_name["epoch"]["epoch"] == 0
+    assert by_name["data_wait"]["path"] == "epoch/data_wait"
+    assert by_name["data_wait"]["dur_s"] == 0.25
+    # Children are written before parents (exit order), durations nest.
+    assert events[-1]["name"] == "epoch"
+    assert by_name["epoch"]["dur_s"] >= by_name["step"]["dur_s"]
+
+
+def test_span_exit_is_idempotent_and_free_when_unconfigured(tmp_path):
+    s = spans.span("lonely")
+    s.__enter__()
+    s.__exit__(None, None, None)
+    s.__exit__(None, None, None)  # double close: no error, no stack damage
+    assert spans.current_path() == ""
+    # read_events rejects malformed logs loudly.
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"name": "x"}\n')
+    with pytest.raises(ValueError, match="missing keys"):
+        spans.read_events(str(bad))
+    bad.write_text("not json\n")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        spans.read_events(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# heartbeat.py
+
+
+def test_heartbeat_writes_progress_and_span_path(tmp_path):
+    path = str(tmp_path / "obs" / "heartbeat.json")
+    hb = heartbeat.Heartbeat(path, interval_s=0.02, process_index=3,
+                             process_count=8,
+                             span_path_fn=lambda: "epoch/step")
+    with hb:
+        hb.progress(step=17, epoch=2)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                if heartbeat.read(path).get("step") == 17:
+                    break
+            except (OSError, json.JSONDecodeError):
+                pass
+            time.sleep(0.01)
+    payload = heartbeat.read(path)  # stop() flushes a final write
+    assert payload["step"] == 17 and payload["epoch"] == 2
+    assert payload["process_index"] == 3 and payload["process_count"] == 8
+    assert payload["span_path"] == "epoch/step"
+    assert payload["written_ts"] >= payload["last_progress_ts"] > 0
+    assert ":" in payload["host"]
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: decomposition in logs + sidecar, span JSONL
+
+
+def _toy_setup():
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from deepinteract_tpu.data.graph import stack_complexes
+    from deepinteract_tpu.data.synthetic import random_complex
+
+    class Toy(nn.Module):
+        features: int = 4
+
+        @nn.compact
+        def __call__(self, g1, g2, train: bool = False):
+            h1 = nn.Dense(self.features)(g1.node_feats)
+            h2 = nn.Dense(self.features)(g2.node_feats)
+            pair = jnp.einsum("...if,...jf->...ij", h1, h2)
+            return jnp.stack([-pair, pair], axis=-1)
+
+    rng = np.random.default_rng(11)
+    data = [
+        stack_complexes([random_complex(10, 8, rng=rng, n_pad1=16, n_pad2=16,
+                                        knn=4, geo_nbrhd_size=2)])
+        for _ in range(3)
+    ]
+    return Toy(), data
+
+
+def test_trainer_telemetry_sidecar_and_span_log(tmp_path):
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    model, data = _toy_setup()
+    ckpt_dir = str(tmp_path / "ckpt")
+    span_path = str(tmp_path / "events.jsonl")
+    # Explicit sink: earlier tests' fits may have auto-configured one.
+    spans.configure(span_path)
+    try:
+        cfg = LoopConfig(num_epochs=2, ckpt_dir=ckpt_dir, log_every=0,
+                         patience=50, eval_batches_per_dispatch=1,
+                         heartbeat_seconds=0.05)
+        trainer = Trainer(model, cfg, OptimConfig(lr=1e-2, steps_per_epoch=3,
+                                                  num_epochs=2),
+                          log_fn=lambda s: None)
+        state = trainer.init_state(data[0])
+        state, history = trainer.fit(state, data, val_data=data[:1])
+    finally:
+        spans.close()
+
+    # Decomposition rides the history (logs) ...
+    for epoch_metrics in history:
+        for key in ("tele_data_wait_frac", "tele_device_frac",
+                    "tele_checkpoint_frac", "tele_data_wait_s",
+                    "tele_device_s"):
+            assert key in epoch_metrics
+        assert 0.0 <= epoch_metrics["tele_device_frac"] <= 1.0
+        assert 0.0 <= epoch_metrics["tele_data_wait_frac"] <= 1.0
+        assert epoch_metrics["tele_device_s"] > 0.0
+    # ... and the trainer_state.json sidecar.
+    with open(f"{ckpt_dir}/trainer_state.json") as f:
+        sidecar = json.load(f)
+    tele = sidecar["telemetry"]
+    assert tele["tele_checkpoint_frac"] >= 0.0
+    assert tele["tele_device_frac"] > 0.0
+
+    # Span JSONL round-trips and contains the nested phase structure.
+    events = spans.read_events(span_path)
+    paths = {e["path"] for e in events}
+    assert "epoch" in paths
+    assert "epoch/step/device_step" in paths
+    assert "epoch/step/h2d" in paths
+    assert "epoch/data_wait" in paths
+    assert "epoch/eval" in paths
+    assert "epoch/checkpoint" in paths
+    # Two epoch spans (one per epoch), each with its epoch attr.
+    epochs = sorted(e["epoch"] for e in events if e["name"] == "epoch")
+    assert epochs == [0, 1]
+
+    # The heartbeat recorded forward progress with host identity.
+    hb = heartbeat.read(f"{ckpt_dir}/obs/heartbeat_p0.json")
+    assert hb["step"] == 3 and hb["epoch"] == 1
+    assert hb["last_progress_ts"] > 0
+
+    # Registry sinks saw the run: steps counted, epoch scalars mirrored.
+    reg = obs_metrics.get_registry()
+    assert reg.counter("di_train_steps_total").value() >= 6.0
+    assert reg.gauge("di_train_metric", labelnames=("metric",)).value(
+        metric="train_loss") == pytest.approx(history[-1]["train_loss"])
+
+
+def test_trainer_profile_steps_window(tmp_path, monkeypatch):
+    """--profile_dir captures dispatches [1, 1+N): start_trace is called
+    once (not at dispatch 0) and stop_trace always lands, even when the
+    epoch is shorter than N."""
+    import jax
+
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    calls = {"start": [], "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d, **kw: calls["start"].append(d))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+
+    model, data = _toy_setup()
+    cfg = LoopConfig(num_epochs=1, ckpt_dir=None, log_every=0, patience=50,
+                     profile_dir=str(tmp_path / "prof"), profile_steps=99)
+    trainer = Trainer(model, cfg, OptimConfig(lr=1e-2, steps_per_epoch=3,
+                                              num_epochs=1),
+                      log_fn=lambda s: None)
+    state = trainer.init_state(data[0])
+    trainer.fit(state, data)
+    assert calls["start"] == [str(tmp_path / "prof")]  # exactly once
+    assert calls["stop"] == 1  # fit's finally stopped the short window
+    assert not spans.annotations_enabled()  # annotations reset after stop
+
+    # One-dispatch-per-epoch runs still profile: the dispatch counter is
+    # run-global, so the window opens at the second epoch's dispatch.
+    calls["start"], calls["stop"] = [], 0
+    cfg2 = LoopConfig(num_epochs=2, ckpt_dir=None, log_every=0, patience=50,
+                      profile_dir=str(tmp_path / "prof2"), profile_steps=1)
+    trainer2 = Trainer(model, cfg2, OptimConfig(lr=1e-2, steps_per_epoch=1,
+                                                num_epochs=2),
+                       log_fn=lambda s: None)
+    trainer2.fit(trainer2.init_state(data[0]), data[:1])
+    assert calls["start"] == [str(tmp_path / "prof2")]
+    assert calls["stop"] == 1
+
+    # A run that ends before its second dispatch captures nothing but
+    # says so instead of failing or leaving a trace dangling.
+    calls["start"], calls["stop"] = [], 0
+    logs = []
+    cfg3 = LoopConfig(num_epochs=1, ckpt_dir=None, log_every=0, patience=50,
+                      profile_dir=str(tmp_path / "prof3"))
+    trainer3 = Trainer(model, cfg3, OptimConfig(lr=1e-2, steps_per_epoch=1,
+                                                num_epochs=1),
+                       log_fn=logs.append)
+    trainer3.fit(trainer3.init_state(data[0]), data[:1])
+    assert calls["start"] == [] and calls["stop"] == 0
+    assert any("nothing was captured" in m for m in logs)

@@ -356,6 +356,55 @@ def test_http_predict_and_stats_round_trip(server):
         conn.close()
 
 
+def test_metrics_exposition_parses_and_agrees_with_stats(server):
+    """GET /metrics is valid Prometheus text (0.0.4) covering request
+    count, the latency histogram, queue depth, compile-cache size, and
+    result-cache hit rate — and its request/latency counts agree with
+    /stats (same registry histogram underneath)."""
+    from tests.test_obs import parse_prometheus_text
+
+    srv, _, _, _ = server
+    host, port = srv.address
+    # At least one successful predict on the books for this check.
+    _post_npz(host, port, fresh_raw(450))
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    finally:
+        conn.close()
+    samples = parse_prometheus_text(text)  # raises on malformed lines
+
+    names = {n for n, _ in samples}
+    assert "di_serving_queue_depth" in names
+    assert "di_serving_compiled_executables" in names
+    assert "di_serving_result_cache_hit_rate" in names
+    assert "di_serving_request_latency_seconds_bucket" in names
+
+    _, stats = _get(host, port, "/stats")
+    # /metrics histogram count == /stats latency count (the /metrics GET
+    # above and this /stats GET do not touch the predict histogram).
+    assert samples[("di_serving_request_latency_seconds_count",
+                    frozenset())] == stats["latency"]["count"]
+    ok_predicts = samples[("di_serving_requests_total",
+                           frozenset([("endpoint", "/predict"),
+                                      ("status", "200")]))]
+    assert ok_predicts == stats["latency"]["count"]
+    # Scrape-time gauges mirror the engine's live stats.
+    assert samples[("di_serving_compiled_executables", frozenset())] == (
+        stats["engine"]["num_compiled_executables"])
+    assert samples[("di_serving_result_cache_hit_rate", frozenset())] == (
+        pytest.approx(stats["engine"]["result_cache"]["hit_rate"]))
+    # Engine-side counters cover execution and compiles.
+    assert samples[("di_serving_executed_requests_total", frozenset())] >= 1
+    assert samples[("di_serving_compiles_total", frozenset())] >= 1
+    assert samples[("di_serving_flushes_total", frozenset())] >= 1
+
+
 def test_sigterm_drain_completes_inflight_then_refuses(server):
     """PR-1 preemption discipline over the serving stack: a drain request
     (the SIGTERM handler's effect) finishes queued work, answers it, then

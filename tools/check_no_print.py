@@ -1,0 +1,69 @@
+"""Static check: no bare ``print(`` in ``deepinteract_tpu/`` outside ``cli/``.
+
+Library, training, serving, and pipeline code must report through
+``logging`` or the telemetry registry (``deepinteract_tpu/obs``) so output
+is structured, filterable, and visible to exposition — a stray print
+bypasses all three and disappears in multi-host runs. The CLI entry
+points (``deepinteract_tpu/cli/``) and the top-level ``bench.py`` are the
+sanctioned stdout surfaces and are exempt.
+
+AST-based (not grep): only real ``print(...)`` *calls* to the builtin
+name count — ``log_fn=print`` defaults, methods named print, and strings
+mentioning print() do not. Run directly or via the fast-tier test
+``tests/test_no_print.py``::
+
+    python tools/check_no_print.py            # exit 1 + report on violation
+    python tools/check_no_print.py --root path/to/package
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import sys
+from typing import Iterator
+
+# Package subdirectories where bare print() is the intended UX.
+ALLOWED_FIRST_PARTS = {"cli"}
+
+
+def iter_violations(package_root: pathlib.Path) -> Iterator[str]:
+    for path in sorted(package_root.rglob("*.py")):
+        rel = path.relative_to(package_root)
+        if rel.parts and rel.parts[0] in ALLOWED_FIRST_PARTS:
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"),
+                             filename=str(path))
+        except SyntaxError as exc:
+            yield f"{path}:{exc.lineno or 0}: unparseable ({exc.msg})"
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                yield (f"{path}:{node.lineno}: bare print() — use logging "
+                       "or the obs registry (cli/ and bench.py are exempt)")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    default_root = pathlib.Path(__file__).resolve().parents[1] / "deepinteract_tpu"
+    parser.add_argument("--root", type=pathlib.Path, default=default_root,
+                        help="package directory to scan")
+    args = parser.parse_args(argv)
+    if not args.root.is_dir():
+        print(f"error: {args.root} is not a directory", file=sys.stderr)
+        return 2
+    violations = list(iter_violations(args.root))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} bare print() call(s) found")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
