@@ -44,32 +44,25 @@ import time
 
 import numpy as np
 
+# The measurement core (differenced timing, host materialization, compile
+# retry, MFU guard) lives in deepinteract_tpu/tuning/timing.py — SHARED
+# with the autotuner so bench and tuner can never disagree on how time is
+# measured. The module imports no jax at import time, so bench's child
+# processes stay as light as before.
+from deepinteract_tpu.tuning.timing import (  # noqa: E402
+    PEAK_FLOPS_BY_KIND,  # noqa: F401  (re-exported for tools/)
+    is_transient_compile_error as _is_transient,
+    materialize as _materialize,  # noqa: F401  (re-exported for tools/)
+    mfu_guard_violations,
+    resolve_peak_flops,
+    time_compiled as _time_compiled_core,
+)
+
 # One-time measurement of the jitted flagship *train step* on this image's CPU
 # backend (batch 1, 128-pad, single process): see BENCH_NOTES in git history.
 CPU_BASELINE_COMPLEXES_PER_SEC = float(
     os.environ.get("DI_CPU_BASELINE_CPS", "2.23")
 )
-
-# Peak matmul throughput by device kind, for MFU (bf16 peak: XLA runs f32
-# convs through bf16-multipass MXU kernels, so bf16 peak is the roofline
-# either way). Resolved at runtime from jax.devices()[0].device_kind
-# (VERDICT r3 item 1); DI_PEAK_FLOPS overrides.
-PEAK_FLOPS_BY_KIND = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-    "TPU v6e": 918e12,
-}
-
-
-def resolve_peak_flops(device_kind: str) -> float:
-    if "DI_PEAK_FLOPS" in os.environ:
-        return float(os.environ["DI_PEAK_FLOPS"])
-    return PEAK_FLOPS_BY_KIND.get(device_kind, 197e12)
-
 
 PEAK_FLOPS = 197e12  # replaced in main() via resolve_peak_flops()
 
@@ -99,6 +92,7 @@ SECTION_EST_S = {
     "b16_p128_remat": 330,
     "ab_p128": 260,
     "ab_p256": 420,
+    "tuned_ab": 320,
     "b1_p384_tiled": 420,
     "b1_p512_tiled": 480,
     "b1_p128_deeplab": 300,
@@ -183,154 +177,21 @@ def analytic_train_flops(fwd: dict, remat: bool) -> float:
 
 
 # ---------------------------------------------------------------------------
-# Timing
+# Timing — shared core in deepinteract_tpu/tuning/timing.py (see import at
+# top); this wrapper just binds bench's env-driven defaults and stderr log.
 # ---------------------------------------------------------------------------
 
 
-def _is_transient(exc: Exception) -> bool:
-    """Failure signatures of the axon PJRT tunnel worth retrying (shared by
-    every retry loop so a new signature only needs classifying once)."""
-    msg = str(exc)
-    return "remote_compile" in msg or "INTERNAL" in msg
-
-
-def _compile_with_retry(fn, args, attempts: int = 3):
-    """lower+compile with retries: the axon PJRT tunnel's remote_compile
-    sporadically drops the response mid-read (observed ~once per multi-
-    bucket run), which would otherwise cost the driver a whole bucket."""
-    for attempt in range(attempts):
-        try:
-            return fn.lower(*args).compile()
-        except Exception as exc:
-            if attempt == attempts - 1 or not _is_transient(exc):
-                raise
-            _log(f"transient compile failure (attempt {attempt + 1}): "
-                 f"{str(exc).splitlines()[0][:200]}; retrying")
-            time.sleep(5.0 * (attempt + 1))
-
-
-def _materialize(out) -> float:
-    """Force HOST materialization of a value derived from ``out``.
-
-    ``block_until_ready`` alone proved untrustworthy through the axon PJRT
-    tunnel (r2/r3 recorded physically-impossible >1.0 MFU: p256 forward
-    "1.29 ms" ~= p128 forward despite 3.5x the FLOPs — the loop was timing
-    dispatch, not execution; VERDICT r3 item 1). Fetching actual bytes to
-    the host cannot return before the producing execution finishes.
-    """
-    import jax
-
-    leaves = jax.tree_util.tree_leaves(out)
-    leaf = min(leaves, key=lambda a: int(getattr(a, "size", 1 << 62)))
-    return float(np.asarray(jax.device_get(leaf)).ravel()[0])
-
-
-def _arg_variants(args, n: int):
-    """n device-resident copies of ``args``, each with one float leaf
-    perturbed by a harmless epsilon — defeats any same-input caching or
-    result reuse between timed calls.
-
-    All UNPERTURBED leaves are device_put ONCE and shared between the
-    variants: a flagship train state is ~3.4k leaves, and per-leaf
-    transfers through the axon tunnel cost ~10-100 ms each — four full
-    copies (the r4 version) spent several minutes per section just
-    shipping identical bytes (the r5 rehearsal's 900s section timeout)."""
-    import jax
-    import jax.numpy as jnp
-
-    leaves, treedef = jax.tree_util.tree_flatten(args)
-    idx = next(
-        (i for i, l in enumerate(leaves)
-         if hasattr(l, "dtype") and jnp.issubdtype(np.asarray(l).dtype, jnp.floating)),
-        None,
+def _time_compiled(fn, args, iters=None, reps=None):
+    """(compile_s, timing dict, xla_flops) under the shared differenced
+    protocol (tuning/timing.py:time_compiled — the SAME function the
+    autotuner measures with)."""
+    return _time_compiled_core(
+        fn, args,
+        iters=ITERS if iters is None else iters,
+        reps=REPS if reps is None else reps,
+        warmup=WARMUP, log=_log,
     )
-    def put(leaf):
-        # Leaves already resident on an accelerator (e.g. a train state
-        # produced by the jitted init) are kept as-is: re-putting ~3.4k
-        # state leaves costs one tunnel RPC each, minutes per section.
-        if isinstance(leaf, jax.Array):
-            try:
-                if all(d.platform != "cpu" for d in leaf.devices()):
-                    return leaf
-            except Exception:
-                pass
-        return jax.device_put(leaf)
-
-    shared = [put(l) for l in leaves]
-    variants = []
-    for j in range(n):
-        ls = list(shared)
-        if idx is not None and j > 0:
-            ls[idx] = jax.device_put(np.asarray(leaves[idx]) + np.float32(j * 1e-6))
-        variants.append(jax.tree_util.tree_unflatten(treedef, ls))
-    jax.block_until_ready(variants)
-    return variants
-
-
-def _time_compiled(fn, args, iters=ITERS, reps=REPS):
-    """(compile_s, timing dict, xla_flops) for a jitted fn.
-
-    Differenced timing protocol (VERDICT r3 item 1): per rep, time k calls
-    then 2k calls (each run ending in a host fetch of an output leaf) and
-    report per-call = (t_2k - t_k) / k. The subtraction cancels every
-    fixed cost in the timed region — pipeline fill, the host fetch itself,
-    per-dispatch client latency — so the figure is device execution time.
-    ``overhead_ms`` (= t_k - k*per_call) and ``linearity`` (= t_2k/t_k,
-    ideal -> 2 as overhead -> 0) are recorded so a broken-timer regime is
-    visible in the output instead of silently inflating throughput.
-    """
-    import jax
-
-    t0 = time.perf_counter()
-    compiled = _compile_with_retry(fn, args)
-    compile_s = time.perf_counter() - t0
-    flops = None
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):  # older jax returns [dict]
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0)) or None
-    except Exception:
-        pass
-
-    variants = _arg_variants(args, 4)
-
-    def run(ncalls: int) -> float:
-        t0 = time.perf_counter()
-        out = None
-        for i in range(ncalls):
-            out = compiled(*variants[i % len(variants)])
-        jax.block_until_ready(out)
-        _materialize(out)
-        return time.perf_counter() - t0
-
-    for _ in range(WARMUP):
-        run(1)
-    k = max(1, iters // reps)
-    samples, overheads, linearity = [], [], []
-    clamped = 0
-    for _ in range(reps):
-        t1 = run(k)
-        t2 = run(2 * k)
-        per_call = (t2 - t1) / k
-        if per_call <= 1e-9:  # noisy rep: t2 <= t1 (ADVICE r4 item 4)
-            clamped += 1
-            per_call = 1e-9
-        samples.append(per_call)
-        overheads.append(t1 - k * per_call)
-        linearity.append(t2 / t1 if t1 > 0 else float("inf"))
-    timing = {
-        "median": float(np.median(samples)),
-        "min": float(np.min(samples)),
-        "mean": float(np.mean(samples)),
-        "samples": len(samples),
-        "calls_per_sample": k,
-        "overhead_ms": float(np.median(overheads)) * 1e3,
-        "linearity": float(np.median(linearity)),
-        "clamped_samples": clamped,
-        "protocol": "differenced+host-fetch",
-    }
-    return compile_s, timing, flops
 
 
 def _make_batch(batch_size, n1, n2, n_pad, knn=20, geo=2, seed=0):
@@ -347,18 +208,50 @@ def _make_batch(batch_size, n1, n2, n_pad, knn=20, geo=2, seed=0):
     )
 
 
+def _dump_json(payload, path) -> None:
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
 def _dump_partial(detail) -> None:
     """Persist the child's detail fragment after every sub-measurement, so
     a section timeout or crash still leaves the rows already measured for
     the parent to merge (a whole r4 driver run died with only 2 of 6
     sections landed; partial dumps bound the loss to one sub-measurement)."""
-    out = os.environ.get("DI_BENCH_OUT")
-    if not out:
-        return
-    tmp = out + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(detail, fh)
-    os.replace(tmp, out)
+    _dump_json(detail, os.environ.get("DI_BENCH_OUT"))
+
+
+def _dump_parent(detail) -> None:
+    """Parent-side cumulative flush after every merged section (to
+    DI_BENCH_DETAIL_OUT when set): a parent killed between sections leaves
+    the full merged view of everything finished, not just child
+    fragments scattered in temp files."""
+    _dump_json(detail, os.environ.get("DI_BENCH_DETAIL_OUT"))
+
+
+def _crosscheck_mfu(entry, path: str, xla_flops: float,
+                    analytic_flops: float) -> None:
+    """Record the XLA-vs-analytic FLOP cross-check ratio in the bucket
+    entry itself (VERDICT satellite: a repeat of the r2/r3 impossible-MFU
+    readings must be flagged in the RECORD, not only by the hard guard's
+    raise).
+
+    Interpretation: ``cost_analysis`` counts every op and double-counts
+    under remat/fusion, so a healthy ratio is >= ~1 (xla >= analytic,
+    elementwise + recompute overhead). A ratio clearly BELOW 1 means the
+    hand-derived analytic count exceeds what XLA says the graph computes —
+    the analytic MFU is then inflated and untrustworthy, exactly the
+    regime that produced the r2/r3 readings."""
+    ratio = xla_flops / max(analytic_flops, 1.0)
+    entry[f"mfu_crosscheck_ratio_{path}"] = ratio
+    if ratio < 0.9:
+        entry.setdefault("mfu_crosscheck_flags", []).append(
+            f"{path}: xla/analytic FLOP ratio {ratio:.3f} < 0.9 — analytic "
+            "FLOPs (and so analytic MFU) overstate this graph")
 
 
 def bench_bucket(model, state, batch, label, detail, remat, scan_k,
@@ -400,9 +293,9 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
     def guard(keys):
         # Hard guard (VERDICT r3 item 1): analytic MFU is <=1 by
         # construction, so >1 can only mean the timing is wrong. Fail the
-        # bucket loudly rather than publish an impossible number.
-        violations = {k: entry[k] for k in keys
-                      if guard_mfu and k in entry and entry[k] > 1.02}
+        # bucket loudly rather than publish an impossible number. The
+        # threshold logic is shared with the tuner (tuning/timing.py).
+        violations = mfu_guard_violations(entry, keys) if guard_mfu else {}
         if violations:
             detail["buckets"][label] = {
                 "error": f"impossible analytic MFU (>1.0), timing "
@@ -466,6 +359,7 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
         if fxla:
             entry["xla_forward_flops"] = fxla
             entry["xla_forward_mfu"] = (fxla / ft["median"]) / PEAK_FLOPS
+            _crosscheck_mfu(entry, "forward", fxla, afl["forward_flops"])
         guard(("analytic_forward_mfu",))
         _dump_partial(detail)
 
@@ -481,6 +375,7 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
         if txla:
             entry["xla_train_flops"] = txla
             entry["xla_train_mfu"] = (txla / tt["median"]) / PEAK_FLOPS
+            _crosscheck_mfu(entry, "train", txla, a_train)
         guard(("analytic_train_mfu",))
         _dump_partial(detail)
 
@@ -635,6 +530,12 @@ def _section_names(platform: str) -> list:
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "b8_p128_bf16", "b1_p256",
              "b1_p384_tiled", "eval_path"]
+    if os.environ.get("DI_TUNING_STORE"):
+        # Tuned-vs-default A/B row (right after the headline bucket so a
+        # budget-truncated run still lands it): only when an operator
+        # points DI_TUNING_STORE at a persisted store — there is nothing
+        # to A/B against otherwise.
+        names.insert(1, "tuned_ab")
     if os.environ.get("DI_BENCH_EXTRA"):
         names += [n for n in EXTRA_SHAPES if n not in names]
     return names
@@ -850,12 +751,86 @@ def _run_eval_section(ctx, detail) -> None:
     _log(json.dumps({"eval_path_b128": ev}))
 
 
+def _run_tuned_ab_section(ctx, detail) -> None:
+    """Tuned-vs-default A/B at the bucket named by DI_TUNED_AB_BUCKET
+    (default: the headline b1 p128): both sides run the scanned train
+    step through the same differenced protocol — the default side is the
+    hardcoded config every entry point ships with (tuning/space.py
+    ``default_trial``), the tuned side is whatever the store
+    (DI_TUNING_STORE) resolved for this device/model/bucket. The row is
+    the evidence line for "did tuning actually buy anything here"."""
+    import jax
+
+    from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+    from deepinteract_tpu.training.optim import OptimConfig
+    from deepinteract_tpu.training.steps import (
+        create_train_state,
+        multi_train_step,
+        stack_microbatches,
+    )
+    from deepinteract_tpu.tuning import consume
+    from deepinteract_tpu.tuning.space import (
+        apply_to_model_config,
+        apply_to_optim_config,
+        default_trial,
+    )
+    from deepinteract_tpu.tuning.store import TuningStore
+
+    store_path = os.environ["DI_TUNING_STORE"]
+    bs, pad = (int(v) for v in
+               os.environ.get("DI_TUNED_AB_BUCKET", "1x128").split("x"))
+    n1, n2 = {128: (100, 80), 256: (230, 200)}.get(pad, (pad - 28, pad - 48))
+    base_cfg = ModelConfig()
+    row = {"store": store_path, "bucket": f"b{bs}_p{pad}"}
+    detail["tuned_ab"] = row
+    store = TuningStore.load(store_path)
+    adopted = consume.lookup(store, base_cfg, bs, pad)
+    if adopted is None:
+        row["skipped"] = (f"no tuning-store entry for b{bs}_p{pad} on this "
+                          "device/model")
+        return
+    row["config"] = adopted.config.to_dict()
+    row["source"] = adopted.source
+    for side in ("default", "tuned"):
+        trial = default_trial() if side == "default" else adopted.config
+        scan_k = (trial.scan_k
+                  if side == "tuned" and adopted.scan_k_applies
+                  else ctx["scan_k"])
+        model = DeepInteract(apply_to_model_config(base_cfg, trial))
+        batch = _make_batch(bs, n1, n2, pad)
+        state = create_train_state(
+            model, jax.tree_util.tree_map(lambda x: x[:1], batch),
+            # The tuned side runs the microbatch (grad-accum) setting it
+            # was measured with; the default side the hardcoded default.
+            optim_cfg=apply_to_optim_config(
+                OptimConfig(steps_per_epoch=100, num_epochs=50), trial),
+        )
+        stacked = stack_microbatches([batch] * scan_k)
+        mstep = jax.jit(lambda s, bst: multi_train_step(s, bst))
+        mc, mt, _ = _time_compiled(mstep, (state, stacked),
+                                   iters=max(ITERS // 4, 3),
+                                   reps=min(REPS, 3))
+        row[side] = {
+            "scan_k": scan_k,
+            "train_scan_ms_per_step": mt["median"] * 1e3 / scan_k,
+            "train_scan_complexes_per_sec": bs * scan_k / mt["median"],
+            "compile_s": mc,
+        }
+        _dump_partial(detail)
+    row["tuned_speedup"] = (row["default"]["train_scan_ms_per_step"]
+                            / row["tuned"]["train_scan_ms_per_step"])
+    _log(json.dumps({"tuned_ab": row}))
+    _dump_partial(detail)
+
+
 def _section_result_key(name: str):
     """Where a section's result (or error) lives in the detail dict:
     (container, key). Buckets nest under 'buckets'; the A/B and eval
     sections use the same top-level keys their successes always used."""
     if name == "eval_path":
         return None, "eval_path_b128"
+    if name == "tuned_ab":
+        return None, "tuned_ab"
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
     return "buckets", name
@@ -877,6 +852,8 @@ def _record_section_error(detail, name: str, msg: str, kind="error") -> None:
 def _run_section(name: str, ctx, detail) -> None:
     if name == "eval_path":
         _run_eval_section(ctx, detail)
+    elif name == "tuned_ab":
+        _run_tuned_ab_section(ctx, detail)
     elif name.startswith("ab_p"):
         _run_ab_section(int(name[4:]), ctx, detail)
     else:
@@ -934,7 +911,25 @@ def _build_headline(detail, scan_k) -> dict:
             entry["train_complexes_per_sec"], 2)
     if "analytic_train_mfu" in entry:
         line["analytic_train_mfu"] = round(entry["analytic_train_mfu"], 4)
+    if _is_partial(detail):
+        # Sections were skipped/failed under the wall budget: the record
+        # says so itself instead of looking complete-but-thin.
+        line["partial"] = True
     return line
+
+
+def _is_partial(detail) -> bool:
+    """True when any section of this run was skipped, errored, or timed
+    out — consumers of the contract line must know the artifact is not the
+    full default section list."""
+    if detail.get("section_incidents"):
+        return True
+    candidates = list(detail.get("buckets", {}).values())
+    candidates += [v for k, v in detail.items()
+                   if k.startswith(("attention_ab", "eval_path", "tuned_ab"))
+                   and isinstance(v, dict)]
+    return any(("skipped" in c or "error" in c) for c in candidates
+               if isinstance(c, dict))
 
 
 def _emit_headline(detail, scan_k) -> None:
@@ -1019,6 +1014,7 @@ def _run_sections_isolated(names, detail, scan_k) -> None:
             _record_section_error(detail, name, err)
         else:
             _record_section_error(detail, name, "section produced no output")
+        _dump_parent(detail)
         if name == "b1_p128":
             _emit_headline(detail, scan_k)
 

@@ -209,6 +209,23 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
                    help="per-request wait bound inside the HTTP handler")
 
 
+def add_tuning_args(p: argparse.ArgumentParser) -> None:
+    """Autotuning surface shared by train/serve/tune (tuning/)."""
+    g = p.add_argument_group("autotuning")
+    g.add_argument("--autotune", action="store_true",
+                   help="resolve remat/scan_k/scan_chunks/Pallas-block "
+                        "configs from the tuning store at startup (run "
+                        "`python -m deepinteract_tpu.cli.tune` to build "
+                        "it); missing entries fall back to the defaults "
+                        "with a log line")
+    g.add_argument("--tuning_store", type=str, default=None,
+                   help="path of the persisted tuning store JSON "
+                        "(default: <ckpt_dir>/tuning_store.json)")
+    from deepinteract_tpu.tuning.compile_cache import add_compile_cache_arg
+
+    add_compile_cache_arg(g)
+
+
 def add_logging_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("logging")
     g.add_argument("--experiment_name", type=str, default=None)
@@ -251,6 +268,7 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     add_data_args(p)
     add_model_args(p)
     add_training_args(p)
+    add_tuning_args(p)
     add_logging_args(p)
     return p
 

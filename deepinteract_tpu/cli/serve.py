@@ -50,6 +50,26 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     from deepinteract_tpu.serving import EngineConfig, InferenceEngine, ServingServer
+    from deepinteract_tpu.tuning.compile_cache import (
+        enable_compile_cache,
+        resolve_cache_dir,
+    )
+    from deepinteract_tpu.tuning.store import default_store_path
+
+    enable_compile_cache(
+        resolve_cache_dir(args.compile_cache_dir,
+                          args.ckpt_name or args.ckpt_dir))
+
+    tuning_store = None
+    if args.autotune:
+        import os
+
+        tuning_store = args.tuning_store or default_store_path(
+            args.ckpt_name or args.ckpt_dir)
+        if not os.path.exists(tuning_store):
+            print(f"autotune: tuning store {tuning_store} not found; "
+                  "serving with default configs")
+            tuning_store = None
 
     model_cfg, _, _ = configs_from_args(args)
     engine_cfg = EngineConfig(
@@ -60,6 +80,7 @@ def main(argv=None) -> int:
         diagonal_buckets=args.diagonal_buckets,
         pad_to_max_bucket=args.pad_to_max_bucket,
         input_indep=args.input_indep,
+        tuning_store=tuning_store,
     )
     engine = InferenceEngine(
         model_cfg,
@@ -71,9 +92,12 @@ def main(argv=None) -> int:
     server = ServingServer(engine, host=args.host, port=args.port,
                            request_timeout_s=args.request_timeout_s)
     host, port = server.address
+    stats = engine.stats()
     print(f"serving on http://{host}:{port} "
-          f"(buckets warm: {engine.stats()['num_compiled_executables']})",
+          f"(buckets warm: {stats['num_compiled_executables']})",
           flush=True)
+    if stats["tuning"]["adopted"]:
+        print(f"autotune: adopted ({stats['tuning']['adopted']})", flush=True)
     return server.run()
 
 

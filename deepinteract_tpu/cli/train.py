@@ -50,6 +50,16 @@ def main(argv=None) -> int:
     from deepinteract_tpu.data.loader import BucketedLoader
     from deepinteract_tpu.models.model import DeepInteract
     from deepinteract_tpu.training.loop import Trainer
+    from deepinteract_tpu.tuning.compile_cache import (
+        enable_compile_cache,
+        resolve_cache_dir,
+    )
+
+    # Persistent XLA compilation cache: repeat compiles of unchanged
+    # graphs (48-247 s each on the benched config) become disk reads;
+    # cache hit/miss counts land in di_compile_cache_* metrics.
+    enable_compile_cache(
+        resolve_cache_dir(args.compile_cache_dir, args.ckpt_dir))
 
     model_cfg, optim_cfg, loop_cfg = configs_from_args(args)
 
@@ -139,6 +149,44 @@ def main(argv=None) -> int:
     optim_cfg = dataclasses.replace(
         optim_cfg, steps_per_epoch=max(train_loader.num_batches(), 1)
     )
+
+    if args.autotune:
+        # Model-side tuned knobs (remat/scan_chunks/Pallas blocks) must
+        # land BEFORE the model is constructed; the Trainer resolves the
+        # loop-side scan_k from the same store at startup and logs the
+        # full adopted tuple (training/loop.py). Active bucket = the most
+        # populated (bucket1, bucket2) pair of the training plan.
+        from deepinteract_tpu.tuning import consume
+        from deepinteract_tpu.tuning.store import default_store_path
+
+        store_path = args.tuning_store or default_store_path(args.ckpt_dir)
+        buckets = train_loader._buckets
+        active = (max(buckets.items(), key=lambda kv: len(kv[1]))[0]
+                  if buckets else (128, 128))
+        pad = max(active)
+        adopted = consume.lookup_path(store_path, model_cfg,
+                                      args.batch_size, pad)
+        # The tuned Pallas grid must be legal at EVERY pad this run can
+        # compile (both chain dims, train + eval plans) — the kernel runs
+        # at each chain's own pad, and an indivisible block count is a
+        # trace-time error, not a slow path.
+        from deepinteract_tpu import constants as C
+
+        plan_pads = {p for loader in (train_loader, val_loader, test_loader)
+                     for key in loader._buckets for p in key}
+        adopted, blocks_note = consume.restrict_pallas_blocks(
+            adopted, plan_pads, knn=C.KNN)
+        model_cfg = consume.adopt_model_config(model_cfg, adopted)
+        if args.accumulate_grad_batches == 1:
+            # Respect an explicit --accumulate_grad_batches: the tuned
+            # microbatch only fills the default.
+            optim_cfg = consume.adopt_optim_config(optim_cfg, adopted)
+        if adopted is not None:
+            print(f"autotune: model config adopts ({adopted.summary()})"
+                  f"{blocks_note}")
+        loop_cfg = dataclasses.replace(
+            loop_cfg, autotune=True, tuning_store=store_path,
+            tuning_bucket=(args.batch_size, pad))
 
     model = DeepInteract(model_cfg)
 

@@ -1,0 +1,200 @@
+"""Tune CLI — search the perf-knob space and persist the winners.
+
+Runs a budget-aware successive-halving search over the tunable axes the
+codebase already exposes (remat / scan_k / microbatch / decoder scan /
+Pallas block grid — see ``tuning/space.py``) for each requested bucket,
+measuring the scanned train step with bench.py's exact differenced-timing
+protocol, and persists the winners into the versioned tuning store that
+``--autotune`` consumers (train / serve / bench) resolve at startup::
+
+    # real search on the live backend (one bucket, 20-minute budget)
+    python -m deepinteract_tpu.cli.tune --tune_buckets 8x128 \
+        --tune_budget_s 1200 --ckpt_dir ckpts/run1
+
+    # pipeline smoke (deterministic cost model, no device work):
+    python -m deepinteract_tpu.cli.tune --dry_run
+
+The store is written after EVERY trial, so a SIGTERM or deadline kill
+keeps everything measured so far (marked ``partial``). Search progress is
+observable: each trial emits an ``obs`` span plus ``di_tuning_*``
+counters, and with ``--ckpt_dir`` the span log lands in
+``<ckpt_dir>/obs/tune_events.jsonl``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Tuple
+
+from deepinteract_tpu.cli.args import build_parser, configs_from_args
+
+
+def parse_bucket_spec(spec: str) -> List[Tuple[int, int]]:
+    """``"1x128,8x128"`` -> [(1, 128), (8, 128)] as (batch, pad)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dims = [int(v) for v in part.lower().split("x")]
+        if len(dims) != 2 or min(dims) < 1:
+            raise ValueError(
+                f"malformed tune bucket {part!r} (want BATCHxPAD, "
+                "e.g. 1x128)")
+        out.append((dims[0], dims[1]))
+    return out
+
+
+def add_tune_args(p) -> None:
+    g = p.add_argument_group("tune")
+    g.add_argument("--dry_run", action="store_true",
+                   help="exercise the full search/store pipeline against a "
+                        "deterministic cost model (no device measurement); "
+                        "entries are marked synthetic")
+    g.add_argument("--tune_buckets", type=str, default="1x128",
+                   help="comma list of BATCHxPAD buckets to tune "
+                        "(e.g. 1x128,8x128)")
+    g.add_argument("--max_trials", type=int, default=24,
+                   help="search-space cap per bucket (near-default configs "
+                        "are explored first)")
+    g.add_argument("--eta", type=int, default=3,
+                   help="successive-halving keep fraction 1/eta per rung")
+    g.add_argument("--base_fidelity", type=int, default=3,
+                   help="timed iterations per rep at rung 0 (each rung "
+                        "multiplies by eta)")
+    g.add_argument("--max_rungs", type=int, default=3)
+    g.add_argument("--trial_deadline_s", type=float, default=600.0,
+                   help="per-trial SIGALRM deadline: an over-budget trial "
+                        "is recorded as a timeout, not a dead run (cannot "
+                        "preempt a compile wedged in native code — run "
+                        "under an outer `timeout(1)` for that; the store "
+                        "is kill-safe either way); 0 disables")
+    g.add_argument("--tune_budget_s", type=float, default=0.0,
+                   help="total wall budget for the whole search; trials "
+                        "past it are skipped with the store intact "
+                        "(0 = unlimited)")
+    g.add_argument("--tune_loader_axes", action="store_true",
+                   help="include the loader's diagonal-bucket axis (only "
+                        "meaningful for corpus-level measurement; the "
+                        "dry-run cost model always includes it)")
+
+
+def _analytic_flops_fn(model_cfg, batch: int, pad: int):
+    """Per-trial analytic train FLOPs for the impossible-MFU guard —
+    bench.py owns the hand-derived FLOP model, so trials are guarded by
+    the SAME arithmetic the benchmark publishes. ``bench`` lives at the
+    repo root (importable when tuning from a checkout, the only place
+    real measurements run); elsewhere the guard degrades to off with a
+    log line rather than blocking the search."""
+    try:
+        from bench import analytic_forward_flops, analytic_train_flops
+    except ImportError:
+        print("analytic-MFU guard off: bench.py not importable from here "
+              "(run from the repo root to enable it)", flush=True)
+        return None
+    g, d = model_cfg.gnn, model_cfg.decoder
+    fwd = analytic_forward_flops(
+        batch, pad, hidden=g.hidden, num_layers=g.num_layers,
+        chunks=d.num_chunks, dec_ch=d.num_channels)
+    return lambda trial: analytic_train_flops(fwd, trial.remat)
+
+
+def main(argv=None) -> int:
+    parser = build_parser(__doc__)
+    add_tune_args(parser)
+    args = parser.parse_args(argv)
+
+    from deepinteract_tpu.obs import spans as obs_spans
+    from deepinteract_tpu.tuning import measure as tmeasure
+    from deepinteract_tpu.tuning.compile_cache import (
+        enable_compile_cache,
+        resolve_cache_dir,
+    )
+    from deepinteract_tpu.tuning.search import SuccessiveHalvingSearch
+    from deepinteract_tpu.tuning.space import (
+        axes_for_bucket,
+        bucket_key,
+        enumerate_trials,
+        model_signature,
+    )
+    from deepinteract_tpu.tuning.store import (
+        TuningStore,
+        default_store_path,
+        runtime_key,
+    )
+
+    enable_compile_cache(
+        resolve_cache_dir(args.compile_cache_dir, args.ckpt_dir))
+
+    import jax
+
+    model_cfg, _, _ = configs_from_args(args)
+    device = jax.devices()[0]
+    store_path = args.tuning_store or default_store_path(args.ckpt_dir)
+    store = TuningStore.load_or_create(store_path)
+    sig = model_signature(model_cfg)
+
+    if args.ckpt_dir and not obs_spans.configured():
+        obs_spans.configure(
+            os.path.join(args.ckpt_dir, "obs", "tune_events.jsonl"))
+
+    summary = {"tuning_store": store_path, "device_kind": device.device_kind,
+               "model_signature": sig, "dry_run": bool(args.dry_run),
+               "buckets": {}}
+    for batch, pad in parse_bucket_spec(args.tune_buckets):
+        bucket = bucket_key(batch, pad)
+        axes = axes_for_bucket(
+            batch, pad, device.device_kind,
+            include_loader_axis=args.dry_run or args.tune_loader_axes)
+        trials = enumerate_trials(axes, max_trials=args.max_trials)
+        if args.dry_run:
+            measure = tmeasure.make_dry_run_measure(batch, pad)
+        else:
+            from deepinteract_tpu.tuning.timing import resolve_peak_flops
+
+            measure = tmeasure.make_train_measure(
+                model_cfg, batch, pad, seed=args.seed,
+                analytic_train_flops=_analytic_flops_fn(model_cfg, batch,
+                                                        pad),
+                peak_flops=resolve_peak_flops(device.device_kind))
+        key = runtime_key(sig, bucket)
+        print(f"tuning {bucket}: {len(trials)} configs over "
+              f"{len(axes)} axes -> {store_path}", flush=True)
+        search = SuccessiveHalvingSearch(
+            measure, store=store, store_key=key,
+            eta=args.eta, base_fidelity=args.base_fidelity,
+            max_rungs=args.max_rungs,
+            trial_deadline_s=args.trial_deadline_s or None,
+            total_budget_s=args.tune_budget_s or None,
+            log=lambda m: print(m, flush=True),
+        )
+        result = search.run(trials)
+        entry = store.get(key)
+        if entry is not None and args.dry_run:
+            entry["synthetic"] = True
+            store.save()
+        summary["buckets"][bucket] = {
+            "best": result.best.to_dict() if result.best else None,
+            "best_value": result.best_value,
+            "default_value": result.default_value,
+            "speedup_vs_default": (
+                round(result.default_value / result.best_value, 3)
+                if result.best_value and result.default_value else None),
+            "trials_completed": result.completed,
+            "partial": result.partial,
+        }
+        if result.stopped_reason:
+            summary["buckets"][bucket]["stopped"] = result.stopped_reason
+            break  # the stop request covers the whole run
+    if obs_spans.configured():
+        obs_spans.close()
+    # Machine-readable one-line summary as the final terminal line (same
+    # contract discipline as bench.py).
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,12 @@ class GTConfig:
     # (r4/r5 A/B incl. tools/scan_ab.py, BASELINE.md). 'jnp'/'pallas'
     # force one path ('pallas' still falls back on unsupported buckets).
     attention_impl: str = "auto"
+    # Edge-block grid sizes of the Pallas kernel (forward / backward);
+    # None = the kernel's built-in per-bucket heuristic. Real tunable
+    # parameters (ops/pallas_attention.py:edge_block_options) searched by
+    # the autotuner (tuning/space.py) and adopted from its store.
+    pallas_fwd_blocks: "int | None" = None
+    pallas_bwd_blocks: "int | None" = None
 
 
 def _split_geo_feats(orig_edge_feats: jnp.ndarray):
@@ -265,7 +271,9 @@ def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask,
 
         # Off-TPU (forced 'pallas', e.g. CPU tests) runs the interpreter.
         interpret = jax.default_backend() != "tpu"
-        return edge_attention_pallas(q, kk, v, proj_e, nbr_idx, edge_mask, interpret)
+        return edge_attention_pallas(q, kk, v, proj_e, nbr_idx, edge_mask,
+                                     interpret, cfg.pallas_fwd_blocks,
+                                     cfg.pallas_bwd_blocks)
     return edge_attention(q, kk, v, proj_e, nbr_idx, edge_mask, mode=cfg.attention_mode)
 
 

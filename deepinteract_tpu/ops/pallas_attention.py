@@ -54,15 +54,52 @@ from deepinteract_tpu.ops.attention import CLIP, EPS, edge_attention
 MAX_KERNEL_NODES = 256
 
 
-def _num_edge_blocks(n: int) -> int:
+def _num_edge_blocks(n: int, override=None) -> int:
+    if override is not None:
+        return int(override)
     return 1 if n <= 128 else n // 64
 
 
-def _num_edge_blocks_bwd(n: int) -> int:
+def _num_edge_blocks_bwd(n: int, override=None) -> int:
+    if override is not None:
+        return int(override)
     # The backward kernel holds ~2x the per-edge working set of forward
     # (both gradient and recomputed-forward tiles), so it halves the edge
     # block relative to forward to stay comfortably inside VMEM at n=256.
     return 1 if n <= 128 else n // 32
+
+
+def edge_block_options(n: int, knn: int = 20, backward: bool = False,
+                       ) -> tuple:
+    """Legal edge-block grid sizes for a bucket — the tunable axis the
+    autotuner searches (``tuning/space.py``).
+
+    Legality is structural only: the block count must divide the edge
+    list evenly and leave sublane-aligned blocks of useful size. Whether
+    a legal grid is FAST (or even fits VMEM at a given batch) is exactly
+    what the tuner measures — an over-aggressive grid fails its trial's
+    compile and is recorded as a failed config, not guessed at here. The
+    built-in heuristic values are always included."""
+    e = n * knn
+    default = _num_edge_blocks_bwd(n) if backward else _num_edge_blocks(n)
+    opts = {default} if e % default == 0 else set()
+    for nb in (1, 2, 3, 4, 6, 8, 12, 16, 24, 32):
+        if e % nb:
+            continue
+        eb = e // nb
+        if eb % 8 or eb < 128:  # sublane alignment / degenerate blocks
+            continue
+        opts.add(nb)
+    return tuple(sorted(opts))
+
+
+def _check_blocks(n: int, knn: int, nb: int, tag: str) -> None:
+    e = n * knn
+    if e % nb:
+        raise ValueError(
+            f"pallas edge attention: {tag} block count {nb} does not "
+            f"divide the edge list (n={n}, knn={knn}, E={e}); legal "
+            f"counts: {edge_block_options(n, knn)}")
 
 
 def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
@@ -220,12 +257,14 @@ def _bwd_kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, z_ref,
     dv_ref[0] += scatter(onehot_src, w_full * dnum_dst)
 
 
-def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
+def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False,
+                    num_blocks=None):
     b, n, h, d = q.shape
     kk = nbr_idx.shape[-1]
     e = n * kk
     hd = h * d
-    nb = _num_edge_blocks(n)
+    nb = _num_edge_blocks(n, num_blocks)
+    _check_blocks(n, kk, nb, "forward")
     eb = e // nb
 
     kernel = functools.partial(
@@ -267,12 +306,13 @@ def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
 
 
 def _pallas_backward(q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out,
-                     dh, de, interpret=False):
+                     dh, de, interpret=False, num_blocks=None):
     b, n, h, d = q.shape
     kk = nbr_idx.shape[-1]
     e = n * kk
     hd = h * d
-    nb = _num_edge_blocks_bwd(n)
+    nb = _num_edge_blocks_bwd(n, num_blocks)
+    _check_blocks(n, kk, nb, "backward")
     eb = e // nb
 
     kernel = functools.partial(
@@ -315,29 +355,40 @@ def _pallas_backward(q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out,
             dv.reshape(b, n, h, d), dpe.reshape(b, n, kk, h, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def edge_attention_pallas(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def edge_attention_pallas(q, k, v, proj_e, nbr_idx, edge_mask,
+                          interpret=False, fwd_blocks=None, bwd_blocks=None):
     """Drop-in replacement for ``edge_attention(..., mode='scatter')`` on
-    TPU for buckets with N <= MAX_KERNEL_NODES. Returns (h_out, e_out)."""
+    TPU for buckets with N <= MAX_KERNEL_NODES. Returns (h_out, e_out).
+
+    ``fwd_blocks``/``bwd_blocks`` override the edge-block grid sizes
+    (None = the built-in per-bucket heuristic). These are the real
+    block-shape parameters the autotuner searches — see
+    :func:`edge_block_options` for legality and ``tuning/space.py`` for
+    the axis definition. Numerics: a different block count only changes
+    float accumulation order across edge blocks (tolerance-level parity,
+    same as the existing n > 128 path)."""
     h_out, e_out, _ = _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask,
-                                      interpret)
+                                      interpret, fwd_blocks)
     return h_out, e_out
 
 
-def _fwd(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False):
+def _fwd(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False,
+         fwd_blocks=None, bwd_blocks=None):
     h_out, e_out, z_out = _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask,
-                                          interpret)
+                                          interpret, fwd_blocks)
     # h and z (the softmax denominator) ride along as residuals so the
     # backward kernel never re-runs the full forward — it recomputes only
     # the per-edge quantities block-locally.
     return (h_out, e_out), (q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out)
 
 
-def _bwd(interpret, res, grads):
+def _bwd(interpret, fwd_blocks, bwd_blocks, res, grads):
     q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out = res
     dh, de = grads
     dq, dk, dv, dpe = _pallas_backward(
-        q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out, dh, de, interpret
+        q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out, dh, de, interpret,
+        bwd_blocks,
     )
     return dq, dk, dv, dpe, None, None
 

@@ -34,6 +34,7 @@ device-execution latency. The engine owns exactly that amortization:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from concurrent.futures import Future
@@ -48,6 +49,8 @@ from deepinteract_tpu.data.loader import make_bucket_fn
 from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.serving.cache import ResultCache, content_hash
 from deepinteract_tpu.serving.scheduler import MicroBatchScheduler
+
+logger = logging.getLogger(__name__)
 
 # Registry counters are PROCESS-wide (/metrics scope) and deliberately
 # parallel to the engine's per-instance attributes (/stats scope): two
@@ -92,6 +95,15 @@ class EngineConfig:
     # Zero all input features (the scientific-control path); part of the
     # result-cache key since it changes the output for the same upload.
     input_indep: bool = False
+    # Tuning-store path (tuning/store.py): when set, the engine resolves
+    # the tuned config for its ACTIVE bucket (first warmup spec, else the
+    # top bucket) BEFORE any AOT compile. Forward-relevant knobs are
+    # applied to the (model-wide) config: the decoder chunk scan when no
+    # checkpoint pins the param layout, and the Pallas block grid only
+    # when it is legal for EVERY warmup bucket — a grid tuned for one
+    # bucket must not degrade the others. The full tuned tuple is logged
+    # either way.
+    tuning_store: Optional[str] = None
 
 
 class InferenceEngine:
@@ -117,6 +129,11 @@ class InferenceEngine:
 
         self.cfg = cfg
         base = model_cfg or ModelConfig()
+        # Tuned-config adoption happens on the UN-tiled config (the
+        # signature the tuner measured under); tiling is forced after.
+        self.adopted_tuning = None
+        if cfg.tuning_store:
+            base = self._adopt_tuned(base, ckpt_dir)
         if not base.tile_pair_map:
             base = dataclasses.replace(base, tile_pair_map=True)
         self.model = DeepInteract(base)
@@ -145,6 +162,59 @@ class InferenceEngine:
         self.scheduler = MicroBatchScheduler(
             self._flush, max_batch=cfg.max_batch,
             max_delay_ms=cfg.max_delay_ms)
+
+    # -- autotuning --------------------------------------------------------
+
+    def _adopt_tuned(self, base, ckpt_dir: Optional[str]):
+        """Resolve the tuned config for the engine's active bucket (first
+        warmup spec, else the top bucket at batch 1) and apply the
+        forward-relevant knobs. ``scan_chunks`` changes the PARAM TREE, so
+        it is adopted only when no checkpoint pins the layout; remat and
+        scan_k are training-side knobs — logged as part of the tuple but
+        not applicable to the inference graph."""
+        from deepinteract_tpu.tuning import consume
+
+        if self.cfg.warmup_buckets:
+            b1, b2, bs = self.cfg.warmup_buckets[0]
+        else:
+            b1 = b2 = constants.CHAIN_LENGTH_BUCKETS[-1]
+            bs = 1
+        pad = max(b1, b2)
+        adopted = consume.lookup_path(self.cfg.tuning_store, base, bs, pad)
+        if adopted is None:
+            logger.info(
+                "autotune: no tuning-store entry for bucket b%d_p%d in %s; "
+                "serving with default configs", bs, pad,
+                self.cfg.tuning_store)
+            return base
+        # The Pallas grid is a MODEL-wide setting but the entry was tuned
+        # at one symmetric bucket: the kernel runs at each chain's OWN
+        # pad, so the grid applies only when legal at every padded length
+        # this engine will compile (BOTH dims of every warmup bucket).
+        adopted, blocks_note = consume.restrict_pallas_blocks(
+            adopted,
+            {p for spec in (self.cfg.warmup_buckets or ((b1, b2, bs),))
+             for p in spec[:2]},
+            knn=constants.KNN)
+        trial = adopted.config
+        gnn = dataclasses.replace(
+            base.gnn,
+            pallas_fwd_blocks=trial.pallas_fwd_blocks,
+            pallas_bwd_blocks=trial.pallas_bwd_blocks,
+        )
+        decoder = base.decoder
+        scan_note = ""
+        if trial.scan_chunks != base.decoder.scan_chunks:
+            if ckpt_dir is None:
+                decoder = dataclasses.replace(
+                    base.decoder, scan_chunks=trial.scan_chunks)
+            else:
+                scan_note = (" (tuned scan_chunks NOT applied: the "
+                             "checkpoint pins the param layout)")
+        self.adopted_tuning = adopted
+        logger.info("autotune: serving adopts (%s) for bucket b%d_p%d%s%s",
+                    adopted.summary(), bs, pad, scan_note, blocks_note)
+        return dataclasses.replace(base, gnn=gnn, decoder=decoder)
 
     # -- weights -----------------------------------------------------------
 
@@ -401,6 +471,11 @@ class InferenceEngine:
         return {
             "uptime_seconds": time.time() - self._started,
             "restored_from": self.restored_from,
+            "tuning": {
+                "store": self.cfg.tuning_store,
+                "adopted": (self.adopted_tuning.summary()
+                            if self.adopted_tuning is not None else None),
+            },
             "trace_count": self.trace_count,
             "compiled_buckets": compiled,
             "num_compiled_executables": len(compiled),

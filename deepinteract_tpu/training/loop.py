@@ -155,6 +155,16 @@ class LoopConfig:
     # phase-labeled. None disables.
     profile_dir: Optional[str] = None
     profile_steps: int = 3
+    # -- autotuning (tuning/) ---------------------------------------------
+    # With autotune on and a store path set, the Trainer resolves the
+    # tuned scan_k (steps_per_dispatch) for tuning_bucket = (batch, pad)
+    # at startup and logs the full adopted tuple. Model-side knobs (remat,
+    # scan_chunks, Pallas blocks) must be applied BEFORE the model is
+    # constructed — cli/train.py does that through the same
+    # tuning.consume resolution, so the two can never disagree.
+    autotune: bool = False
+    tuning_store: Optional[str] = None
+    tuning_bucket: Optional[tuple] = None  # (batch, pad)
 
 
 class EarlyStopping:
@@ -244,6 +254,31 @@ class Trainer:
         self.mesh = mesh
         self.log = log_fn
         self.metric_writer = metric_writer
+        # Autotune resolution at startup (tuning/consume.py): the tuned
+        # scan_k replaces steps_per_dispatch before the step functions
+        # below are built, and the FULL adopted tuple is logged — the
+        # model-side knobs were applied by the caller through the same
+        # resolution path, so the log line describes the whole config.
+        self.adopted_tuning = None
+        if loop_cfg.autotune and loop_cfg.tuning_store and loop_cfg.tuning_bucket:
+            from deepinteract_tpu.tuning import consume
+            from deepinteract_tpu.tuning.space import bucket_key
+
+            batch, pad = loop_cfg.tuning_bucket
+            adopted = consume.lookup_path(
+                loop_cfg.tuning_store, model.cfg, batch, pad)
+            if adopted is not None:
+                self.cfg = loop_cfg = consume.adopt_loop_config(
+                    loop_cfg, adopted)
+                self.adopted_tuning = adopted
+                self.log(
+                    f"autotune: adopted ({adopted.summary()}) for bucket "
+                    f"{bucket_key(batch, pad)} from {loop_cfg.tuning_store}")
+            else:
+                self.log(
+                    f"autotune: no tuning-store entry for bucket "
+                    f"{bucket_key(batch, pad)} in {loop_cfg.tuning_store}; "
+                    "keeping default configs")
         # Epoch scalars route through a fan-out writer so the telemetry
         # registry always mirrors whatever external sink (wandb/TB) is
         # configured — identical call sequence for that sink either way.

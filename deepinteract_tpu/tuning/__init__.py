@@ -1,0 +1,51 @@
+"""Autotuning subsystem: persisted per-device search over the perf knobs
+every entry point used to hardcode.
+
+Layout:
+
+* :mod:`~deepinteract_tpu.tuning.space` — declarative search space
+  (remat / scan_k / microbatch / scan_chunks / Pallas blocks / bucket
+  diagonalization) and the apply-to-config helpers.
+* :mod:`~deepinteract_tpu.tuning.timing` — the hardened differenced
+  measurement protocol, shared with ``bench.py`` so tuner and bench can
+  never disagree on how time is measured.
+* :mod:`~deepinteract_tpu.tuning.search` — budget-aware successive
+  halving with hard per-trial deadlines and after-every-trial
+  persistence.
+* :mod:`~deepinteract_tpu.tuning.store` — the versioned on-disk store
+  keyed by ``(device_kind, jax version, model signature, bucket)``.
+* :mod:`~deepinteract_tpu.tuning.measure` — real (device) and dry-run
+  (cost-model) trial measurement functions.
+* :mod:`~deepinteract_tpu.tuning.consume` — the one resolution path
+  train / serve / bench use to adopt a tuned config.
+* :mod:`~deepinteract_tpu.tuning.compile_cache` — the shared
+  ``--compile_cache_dir`` plumbing + hit/miss telemetry.
+
+Entry point: ``python -m deepinteract_tpu.cli.tune`` (see README
+"Autotuning").
+"""
+
+from deepinteract_tpu.tuning.consume import Adopted, lookup, lookup_path
+from deepinteract_tpu.tuning.search import SearchResult, SuccessiveHalvingSearch
+from deepinteract_tpu.tuning.space import TrialConfig, bucket_key, model_signature
+from deepinteract_tpu.tuning.store import (
+    SCHEMA_VERSION,
+    StoreSchemaError,
+    TuningStore,
+    runtime_key,
+)
+
+__all__ = [
+    "Adopted",
+    "SCHEMA_VERSION",
+    "SearchResult",
+    "StoreSchemaError",
+    "SuccessiveHalvingSearch",
+    "TrialConfig",
+    "TuningStore",
+    "bucket_key",
+    "lookup",
+    "lookup_path",
+    "model_signature",
+    "runtime_key",
+]

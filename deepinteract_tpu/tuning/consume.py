@@ -1,0 +1,159 @@
+"""Consumer side: resolve a tuned config from the store and apply it.
+
+Used by ``cli/train.py`` (model-side knobs must apply BEFORE the model is
+constructed), :class:`deepinteract_tpu.training.loop.Trainer` (loop-side
+scan_k at startup), the serving engine (per-bucket warmup), and bench's
+tuned-vs-default A/B — one resolution path, so every consumer agrees on
+what "the tuned config for this bucket" means.
+
+Lookup order:
+
+1. exact key ``(device_kind, jax version, model signature, bucket)``;
+2. any-bucket fallback for the same device + model: model-side knobs
+   (remat, scan_chunks, Pallas blocks) transfer across buckets far better
+   than scan_k does, so the fallback adoption DROPS scan_k (keeps the
+   caller's default) and says so in the adoption summary.
+
+Multi-host reads go through the store's replicated path — every host
+adopts identical knobs by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deepinteract_tpu.tuning.space import (
+    TrialConfig,
+    apply_to_loop_config,
+    apply_to_model_config,
+    apply_to_optim_config,
+    bucket_key,
+    model_signature,
+)
+from deepinteract_tpu.tuning.store import TuningStore, runtime_key
+
+
+@dataclasses.dataclass(frozen=True)
+class Adopted:
+    """One resolved adoption: the config, where it came from, and whether
+    scan_k is trustworthy for the caller's bucket."""
+
+    config: TrialConfig
+    key: str
+    source: str  # 'exact' | 'bucket_fallback'
+    partial: bool = False
+
+    @property
+    def scan_k_applies(self) -> bool:
+        return self.source == "exact"
+
+    def summary(self) -> str:
+        """The log line consumers print — the acceptance-criterion tuple."""
+        c = self.config
+        return (
+            f"remat={'off' if not c.remat else c.remat_policy}, "
+            f"scan_k={c.scan_k if self.scan_k_applies else 'kept-default'}, "
+            f"microbatch={c.microbatch}, "
+            f"scan_chunks={c.scan_chunks}, "
+            f"pallas_blocks=({c.pallas_fwd_blocks}, {c.pallas_bwd_blocks}), "
+            f"diagonal_buckets={c.diagonal_buckets} "
+            f"[{self.source}{', partial search' if self.partial else ''}]"
+        )
+
+
+def lookup(store: Optional[TuningStore], model_cfg, batch: int, pad: int,
+           ) -> Optional[Adopted]:
+    """Resolve the tuned config for ``(model_cfg, b{batch}_p{pad})`` on
+    this process's device, with the any-bucket fallback. None = nothing
+    usable in the store."""
+    if store is None:
+        return None
+    sig = model_signature(model_cfg)
+    bucket = bucket_key(batch, pad)
+    key = runtime_key(sig, bucket)
+    entry = store.get(key)
+    if entry is not None and "config" in entry:
+        return Adopted(config=TrialConfig.from_dict(entry["config"]),
+                       key=key, source="exact",
+                       partial=bool(entry.get("partial")))
+    entry = store.best_entry_any_bucket(sig)
+    if entry is not None and "config" in entry:
+        return Adopted(config=TrialConfig.from_dict(entry["config"]),
+                       key=key, source="bucket_fallback",
+                       partial=bool(entry.get("partial")))
+    return None
+
+
+def lookup_path(store_path: Optional[str], model_cfg, batch: int, pad: int,
+                ) -> Optional[Adopted]:
+    """:func:`lookup` from a path, via the replicated (multi-host-safe)
+    read. A missing store returns None; a schema-mismatched store raises
+    (StoreSchemaError) — silently training on stale knobs is the failure
+    mode the version field exists to prevent."""
+    if not store_path:
+        return None
+    store = TuningStore.load_replicated(store_path)
+    return lookup(store, model_cfg, batch, pad)
+
+
+def restrict_pallas_blocks(adopted: Optional[Adopted], pads,
+                           knn: int = 20):
+    """Strip the tuned Pallas grid unless it is legal at EVERY padded
+    chain length in ``pads``.
+
+    The grid is a model-wide setting but the entry was tuned at one
+    symmetric bucket; the kernel runs at each chain's OWN pad, so a
+    multi-bucket training plan (or an asymmetric serving bucket) can
+    reach pads the tuned block count does not divide — which is a trace-
+    time ValueError, not a slow path. Callers pass every distinct pad
+    their plan can compile (both chain dims). Returns ``(adopted, note)``
+    where ``note`` is non-empty when the grid was dropped."""
+    if adopted is None:
+        return adopted, ""
+    c = adopted.config
+    if c.pallas_fwd_blocks is None and c.pallas_bwd_blocks is None:
+        return adopted, ""
+    from deepinteract_tpu.ops.pallas_attention import edge_block_options
+
+    legal = all(
+        (c.pallas_fwd_blocks is None
+         or c.pallas_fwd_blocks in edge_block_options(p, knn))
+        and (c.pallas_bwd_blocks is None
+             or c.pallas_bwd_blocks in edge_block_options(p, knn,
+                                                          backward=True))
+        for p in pads)
+    if legal:
+        return adopted, ""
+    stripped = dataclasses.replace(
+        adopted,
+        config=dataclasses.replace(c, pallas_fwd_blocks=None,
+                                   pallas_bwd_blocks=None))
+    return stripped, (" (tuned Pallas grid NOT applied: illegal for at "
+                      "least one bucket pad in the plan)")
+
+
+def adopt_model_config(model_cfg, adopted: Optional[Adopted]):
+    """Apply the model-side tuned knobs (remat, remat_policy, scan_chunks,
+    Pallas blocks). Returns ``model_cfg`` unchanged when nothing was
+    adopted."""
+    if adopted is None:
+        return model_cfg
+    return apply_to_model_config(model_cfg, adopted.config)
+
+
+def adopt_loop_config(loop_cfg, adopted: Optional[Adopted]):
+    """Apply the loop-side tuned knobs (scan_k -> steps_per_dispatch).
+    Fallback adoptions keep the caller's scan_k (see module doc)."""
+    if adopted is None or not adopted.scan_k_applies:
+        return loop_cfg
+    return apply_to_loop_config(loop_cfg, adopted.config)
+
+
+def adopt_optim_config(optim_cfg, adopted: Optional[Adopted]):
+    """Apply the optimizer-side tuned knob (microbatch ->
+    accumulate_steps). The tuner measured the objective WITH this setting,
+    so a consumer that skipped it would run a config nobody measured."""
+    if adopted is None:
+        return optim_cfg
+    return apply_to_optim_config(optim_cfg, adopted.config)

@@ -1,0 +1,341 @@
+"""Budget-aware successive-halving search with incremental persistence.
+
+The search loop is deliberately boring and interruption-obsessed, because
+the measurement environment is not: compiles take 48-247 s, the transport
+drops responses, and the driver enforces wall-clock kills. Rules:
+
+* **Successive halving** (Jamieson & Talwalkar): rung 0 measures every
+  candidate at a small fidelity (few timed iterations), keeps the best
+  ``1/eta`` fraction, and re-measures survivors at ``eta``x the fidelity —
+  cheap configs die cheaply, the winner is measured most carefully.
+* **Hard per-trial deadline.** Each measurement runs under a SIGALRM
+  timer (main thread; no-op elsewhere): an over-budget trial becomes a
+  recorded ``timeout``, not a dead tuning run. Honesty note: CPython only
+  runs the handler between bytecodes, so the alarm preempts Python-level
+  work and interruptible syscalls — a compile wedged inside native XLA
+  code is NOT preemptible in-process (run the whole tune under an outer
+  ``timeout(1)`` for that; the store is kill-safe by construction, and a
+  second SIGTERM/SIGINT escalates to an immediate abort).
+* **Incremental persistence.** The store is rewritten (atomically) after
+  EVERY trial — a SIGTERM, deadline kill, or crash keeps everything
+  measured so far, marked ``partial`` (the BENCH_r03/r04 rc=124 lesson).
+* **Observable.** Every trial emits an ``obs`` span
+  (``tuning_trial``) and ``di_tuning_*`` counters, so a live tuning run
+  reports progress through the same telemetry as training and serving.
+
+The measure function is injected (``measure(trial, fidelity) -> (value,
+detail)``), which is what makes the loop testable with a fake timer and
+lets ``cli.tune --dry_run`` exercise the whole pipeline on CPU in
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.obs import spans as obs_spans
+from deepinteract_tpu.tuning.space import TrialConfig, canonicalize, default_trial
+from deepinteract_tpu.tuning.store import TuningStore
+
+_TRIALS = obs_metrics.counter(
+    "di_tuning_trials_total", "Tuning trials by outcome",
+    labelnames=("status",))
+_TRIAL_SECONDS = obs_metrics.histogram(
+    "di_tuning_trial_seconds", "Wall time of each tuning trial")
+_RUNGS = obs_metrics.counter(
+    "di_tuning_rungs_total", "Completed successive-halving rungs")
+_STORE_WRITES = obs_metrics.counter(
+    "di_tuning_store_writes_total", "Incremental tuning-store persists")
+
+MeasureFn = Callable[[TrialConfig, int], Tuple[float, Dict]]
+
+
+class TrialTimeout(Exception):
+    """A trial hit its hard wall-clock deadline."""
+
+
+class SearchStopped(Exception):
+    """SIGTERM/SIGINT requested a stop; everything measured is persisted."""
+
+
+@contextlib.contextmanager
+def _hard_deadline(seconds: Optional[float]):
+    """SIGALRM-based per-trial deadline. Engages only on the main thread
+    of a Unix process (signal handlers cannot be installed elsewhere);
+    otherwise the deadline is advisory via the caller's budget check. The
+    timer is always cancelled on exit, so a fast trial cannot be killed
+    by a stale alarm. Scope: the raise lands at the next bytecode — it
+    interrupts Python-level work and interruptible syscalls, not a
+    compile wedged inside native code (see module docstring)."""
+    if (not seconds or seconds <= 0
+            or threading.current_thread() is not threading.main_thread()
+            or not hasattr(signal, "SIGALRM")):
+        yield
+        return
+
+    def _raise(signum, frame):
+        raise TrialTimeout(f"trial exceeded {seconds:.0f}s deadline")
+
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.setitimer(signal.ITIMER_REAL, float(seconds))
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: TrialConfig
+    status: str  # 'ok' | 'timeout' | 'error' | 'skipped'
+    value: Optional[float] = None  # objective, lower is better
+    rung: int = 0
+    fidelity: int = 0
+    seconds: float = 0.0
+    detail: Optional[Dict] = None
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        d = {
+            "config": self.config.to_dict(),
+            "status": self.status,
+            "rung": self.rung,
+            "fidelity": self.fidelity,
+            "seconds": round(self.seconds, 3),
+        }
+        if self.value is not None:
+            d["value"] = self.value
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+@dataclasses.dataclass
+class SearchResult:
+    best: Optional[TrialConfig]
+    best_value: Optional[float]
+    default_value: Optional[float]
+    results: List[TrialResult]
+    partial: bool
+    stopped_reason: Optional[str] = None
+
+    @property
+    def completed(self) -> int:
+        return sum(1 for r in self.results if r.status == "ok")
+
+
+class SuccessiveHalvingSearch:
+    """Drives one bucket's search and persists into ``store`` under
+    ``store_key`` after every trial."""
+
+    def __init__(
+        self,
+        measure: MeasureFn,
+        *,
+        store: Optional[TuningStore] = None,
+        store_key: Optional[str] = None,
+        objective: str = "train_scan_ms_per_step",
+        eta: int = 3,
+        base_fidelity: int = 3,
+        max_rungs: int = 3,
+        trial_deadline_s: Optional[float] = None,
+        total_budget_s: Optional[float] = None,
+        install_signal_handlers: bool = True,
+        log: Callable[[str], None] = lambda _m: None,
+    ):
+        self.measure = measure
+        self.store = store
+        self.store_key = store_key
+        self.objective = objective
+        self.eta = max(2, int(eta))
+        self.base_fidelity = max(1, int(base_fidelity))
+        self.max_rungs = max(1, int(max_rungs))
+        self.trial_deadline_s = trial_deadline_s
+        self.total_budget_s = total_budget_s
+        self.install_signal_handlers = install_signal_handlers
+        self.log = log
+        self._stop = threading.Event()
+        self._stop_reason: Optional[str] = None
+        self._t0 = time.monotonic()
+
+    # -- interruption ------------------------------------------------------
+
+    def request_stop(self, reason: str) -> None:
+        """Cooperative stop: honored between trials; the in-flight trial
+        still finishes (or hits its own deadline). Everything measured is
+        already on disk by then."""
+        if not self._stop.is_set():
+            self._stop_reason = reason
+            self._stop.set()
+
+    @contextlib.contextmanager
+    def _signals(self):
+        if (not self.install_signal_handlers
+                or threading.current_thread() is not threading.main_thread()):
+            yield
+            return
+        old = {}
+
+        def handler(signum, frame):
+            name = signal.Signals(signum).name
+            if self._stop.is_set():
+                # Second signal: the operator means NOW. Everything
+                # measured is already persisted, so an immediate abort
+                # loses nothing — and a trial wedged in native code
+                # would never reach the cooperative stop point.
+                raise KeyboardInterrupt(
+                    f"second {name}: aborting immediately "
+                    "(store holds every completed trial)")
+            self.request_stop(f"signal {name}")
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            old[sig] = signal.signal(sig, handler)
+        try:
+            yield
+        finally:
+            for sig, prev in old.items():
+                signal.signal(sig, prev)
+
+    def _remaining_s(self) -> float:
+        if self.total_budget_s is None:
+            return math.inf
+        return self.total_budget_s - (time.monotonic() - self._t0)
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist(self, results: List[TrialResult], trials_total: int,
+                 partial: bool) -> None:
+        if self.store is None or self.store_key is None:
+            return
+        ok = [r for r in results if r.status == "ok" and r.value is not None]
+        entry: Dict = {
+            "objective": self.objective,
+            "trials_completed": len(ok),
+            "trials_total": trials_total,
+            "partial": partial,
+            "measured_at": time.time(),
+            "trial_log": [r.to_dict() for r in results],
+        }
+        if ok:
+            # Highest-rung first, then lowest objective: a rung-2 value is
+            # measured at eta^2 the fidelity of a rung-0 one and wins ties.
+            best = min(ok, key=lambda r: (-r.rung, r.value))
+            entry["config"] = best.config.to_dict()
+            entry["value"] = best.value
+            base = canonicalize(default_trial())
+            defaults = [r for r in ok if canonicalize(r.config) == base]
+            if defaults:
+                entry["default_value"] = min(
+                    defaults, key=lambda r: (-r.rung, r.value)).value
+        else:
+            existing = self.store.get(self.store_key)
+            if existing is not None and "config" in existing:
+                # A refresh run that has measured NOTHING yet must not
+                # destroy a previously measured winner: keep the old
+                # entry and attach this search's (so-far-empty) record.
+                entry = dict(existing, last_failed_search=entry)
+        self.store.put(self.store_key, entry)
+        self.store.save()
+        _STORE_WRITES.inc()
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, trials: Sequence[TrialConfig]) -> SearchResult:
+        results: List[TrialResult] = []
+        trials_total = len(trials)
+        survivors = list(trials)
+        partial = False
+        with self._signals():
+            for rung in range(self.max_rungs):
+                fidelity = self.base_fidelity * (self.eta ** rung)
+                rung_results: List[TrialResult] = []
+                for trial in survivors:
+                    if self._stop.is_set():
+                        partial = True
+                        break
+                    if self._remaining_s() <= 0:
+                        self.request_stop("total budget exhausted")
+                        partial = True
+                        break
+                    res = self._run_trial(trial, rung, fidelity)
+                    results.append(res)
+                    if res.status == "ok":
+                        rung_results.append(res)
+                    # Incremental persistence: the store is valid after
+                    # every trial, kill-safe by construction.
+                    self._persist(results, trials_total, partial=True)
+                else:
+                    _RUNGS.inc()
+                    survivors = self._select(rung_results)
+                    if not survivors:
+                        break
+                    # A lone survivor still gets its remaining rungs: the
+                    # winner's published value comes from the HIGHEST
+                    # fidelity measured (max_rungs bounds the cost).
+                    continue
+                break  # inner break (stop/budget) propagates out
+        ok = [r for r in results if r.status == "ok" and r.value is not None]
+        best = min(ok, key=lambda r: (-r.rung, r.value)) if ok else None
+        base = canonicalize(default_trial())
+        defaults = [r for r in ok if canonicalize(r.config) == base]
+        default_value = (min(defaults, key=lambda r: (-r.rung, r.value)).value
+                         if defaults else None)
+        partial = partial or self._stop.is_set()
+        self._persist(results, trials_total, partial=partial)
+        return SearchResult(
+            best=best.config if best else None,
+            best_value=best.value if best else None,
+            default_value=default_value,
+            results=results,
+            partial=partial,
+            stopped_reason=self._stop_reason,
+        )
+
+    def _select(self, rung_results: List[TrialResult]) -> List[TrialConfig]:
+        if not rung_results:
+            return []
+        keep = max(1, len(rung_results) // self.eta)
+        ranked = sorted(rung_results,
+                        key=lambda r: (r.value, r.config.label()))
+        return [r.config for r in ranked[:keep]]
+
+    def _run_trial(self, trial: TrialConfig, rung: int,
+                   fidelity: int) -> TrialResult:
+        t0 = time.perf_counter()
+        status, value, detail, err = "ok", None, None, None
+        with obs_spans.span("tuning_trial", config=trial.label(),
+                            rung=rung, fidelity=fidelity):
+            try:
+                with _hard_deadline(self.trial_deadline_s):
+                    value, detail = self.measure(trial, fidelity)
+                value = float(value)
+                if not math.isfinite(value):
+                    status, err = "error", f"non-finite objective {value}"
+                    value = None
+            except TrialTimeout as exc:
+                status, err = "timeout", str(exc)
+            except SearchStopped as exc:
+                status, err = "skipped", str(exc)
+                self.request_stop(str(exc))
+            except Exception as exc:  # a failed config is data, not fatal
+                status = "error"
+                err = str(exc).splitlines()[0][:300] if str(exc) else repr(exc)
+        seconds = time.perf_counter() - t0
+        _TRIALS.inc(status=status)
+        _TRIAL_SECONDS.observe(seconds)
+        self.log(
+            f"trial rung={rung} fid={fidelity} [{trial.label()}]: "
+            + (f"{value:.4g} ({self.objective})" if value is not None
+               else f"{status}: {err}")
+            + f" [{seconds:.1f}s]")
+        return TrialResult(config=trial, status=status, value=value,
+                           rung=rung, fidelity=fidelity, seconds=seconds,
+                           detail=detail, error=err)

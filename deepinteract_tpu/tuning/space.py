@@ -1,0 +1,237 @@
+"""Declarative search space over the knobs the codebase already exposes.
+
+Every axis here names a real configuration surface that existed before the
+tuner — nothing is invented for tuning's sake:
+
+* ``remat`` / ``remat_policy`` — decoder rematerialization
+  (``models/decoder.py:DecoderConfig``; the b8 p128 HBM lever).
+* ``scan_k`` — train steps scanned per device dispatch
+  (``training/loop.py:LoopConfig.steps_per_dispatch``; the single biggest
+  single-chip throughput lever through a remote-dispatch transport).
+* ``microbatch`` — gradient-accumulation microbatches
+  (``training/optim.py:OptimConfig.accumulate_steps``).
+* ``scan_chunks`` — decoder chunk scan vs unroll
+  (``DecoderConfig.scan_chunks``; ~5-8x compile-time difference).
+* ``pallas_fwd_blocks`` / ``pallas_bwd_blocks`` — edge-block grid sizes of
+  the fused attention kernel (``ops/pallas_attention.py``; None = the
+  kernel's built-in heuristic).
+* ``diagonal_buckets`` — loader bucket diagonalization
+  (``data/loader.py``; compile count vs pad FLOPs trade).
+
+The space is bucket- and device-aware: axes that cannot apply to a given
+``(batch, pad)`` bucket (a Pallas grid the kernel rejects, a scan_k of 1
+"searched" twice) are pruned at enumeration time, so the search loop never
+wastes a trial on a config that cannot run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TrialConfig:
+    """One point in the search space. ``None`` on the Pallas axes means
+    "use the kernel's built-in block heuristic"."""
+
+    remat: bool = False
+    remat_policy: str = "full"
+    scan_k: int = 8
+    microbatch: int = 1
+    scan_chunks: bool = True
+    pallas_fwd_blocks: Optional[int] = None
+    pallas_bwd_blocks: Optional[int] = None
+    diagonal_buckets: bool = False
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "TrialConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def label(self) -> str:
+        parts = [
+            f"remat={'off' if not self.remat else self.remat_policy}",
+            f"scan_k={self.scan_k}",
+        ]
+        if self.microbatch > 1:
+            parts.append(f"micro={self.microbatch}")
+        if not self.scan_chunks:
+            parts.append("unrolled")
+        if self.pallas_fwd_blocks is not None:
+            parts.append(f"pfwd={self.pallas_fwd_blocks}")
+        if self.pallas_bwd_blocks is not None:
+            parts.append(f"pbwd={self.pallas_bwd_blocks}")
+        if self.diagonal_buckets:
+            parts.append("diag")
+        return ",".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class Axis:
+    """One tunable dimension: a name (TrialConfig field) and its candidate
+    values for the bucket under search."""
+
+    name: str
+    values: Tuple
+    description: str = ""
+
+
+def default_trial() -> TrialConfig:
+    """The configuration every entry point hardcodes today — the A/B
+    baseline the tuner must beat (and bench's 'default' row)."""
+    return TrialConfig()
+
+
+def axes_for_bucket(batch: int, pad: int, device_kind: str = "cpu",
+                    knn: int = 20, tune_pallas: Optional[bool] = None,
+                    include_loader_axis: bool = True) -> List[Axis]:
+    """The applicable axes for one ``(batch, pad)`` bucket.
+
+    ``tune_pallas`` defaults to "is this a TPU" — off-TPU the kernel runs
+    in interpret mode only and block timings are meaningless. p256 remat
+    is forced ON (the scanned decoder backward OOMs a 16G chip without
+    it, bench.py bucket table), so the remat axis collapses there.
+    ``include_loader_axis=False`` drops ``diagonal_buckets`` — the
+    single-bucket synthetic measurement cannot see its effect (it changes
+    corpus-level compile counts and run lengths, not one step's time), so
+    only a corpus-aware caller should search it.
+    """
+    if tune_pallas is None:
+        tune_pallas = "TPU" in device_kind or "tpu" in device_kind
+    axes: List[Axis] = []
+    if pad >= 256:
+        axes.append(Axis("remat", (True,),
+                         "forced: p256 backward OOMs without remat"))
+    else:
+        axes.append(Axis("remat", (False, True), "decoder rematerialization"))
+    axes.append(Axis("remat_policy", ("full", "convs"),
+                     "what remat saves vs recomputes (ignored remat=off)"))
+    axes.append(Axis("scan_k", (1, 4, 8, 16),
+                     "train steps per device dispatch"))
+    # NOT searched: the microbatch (grad-accumulation) axis. It is part
+    # of the declared space (TrialConfig field + apply_to_optim_config)
+    # but the ms-per-scanned-step objective cannot judge it fairly — an
+    # accumulation step is only a FRACTION of an optimizer update, so
+    # microbatch=2 measures faster per step while halving updates per
+    # epoch. Searching it needs an updates-aware (or loss-per-wall)
+    # objective; until then consumers only ever see microbatch=1.
+    axes.append(Axis("scan_chunks", (True, False),
+                     "decoder chunk scan vs unroll"))
+    if tune_pallas:
+        from deepinteract_tpu.ops.pallas_attention import edge_block_options
+
+        fwd = edge_block_options(pad, knn, backward=False)
+        bwd = edge_block_options(pad, knn, backward=True)
+        if len(fwd) > 1:
+            axes.append(Axis("pallas_fwd_blocks", (None,) + fwd,
+                             "forward edge-block grid size (None = heuristic)"))
+        if len(bwd) > 1:
+            axes.append(Axis("pallas_bwd_blocks", (None,) + bwd,
+                             "backward edge-block grid size (None = heuristic)"))
+    if include_loader_axis:
+        axes.append(Axis("diagonal_buckets", (False, True),
+                         "loader bucket diagonalization"))
+    return axes
+
+
+def enumerate_trials(axes: Sequence[Axis], max_trials: int = 64,
+                     ) -> List[TrialConfig]:
+    """Deduplicated grid over ``axes``, default-first, capped.
+
+    Degenerate combinations collapse (``remat=False`` makes every
+    ``remat_policy`` identical), so the dedup happens on the CANONICAL
+    form — the same physical config never runs twice. The full grid is
+    ordered default-config-first (successive halving then always measures
+    the baseline in rung 0) and truncated to ``max_trials`` by cycling
+    axis-distance from the default: near-default configs first, so a tight
+    budget explores one-knob deviations before exotic corners.
+    """
+    names = [a.name for a in axes]
+    seen = set()
+    trials: List[TrialConfig] = []
+    for combo in itertools.product(*[a.values for a in axes]):
+        trial = TrialConfig(**dict(zip(names, combo)))
+        trial = canonicalize(trial)
+        if trial in seen:
+            continue
+        seen.add(trial)
+        trials.append(trial)
+    base = canonicalize(default_trial())
+
+    def distance(t: TrialConfig) -> Tuple[int, str]:
+        d = sum(
+            1 for f in dataclasses.fields(TrialConfig)
+            if getattr(t, f.name) != getattr(base, f.name)
+        )
+        return (d, t.label())
+
+    trials.sort(key=distance)
+    if base in seen and trials[0] != base:
+        trials.remove(base)
+        trials.insert(0, base)
+    return trials[:max_trials]
+
+
+def canonicalize(trial: TrialConfig) -> TrialConfig:
+    """Collapse don't-care fields so physically identical configs compare
+    equal (remat off => policy irrelevant)."""
+    if not trial.remat:
+        return dataclasses.replace(trial, remat_policy="full")
+    return trial
+
+
+# ---------------------------------------------------------------------------
+# Applying a trial to the real config objects
+# ---------------------------------------------------------------------------
+
+
+def apply_to_model_config(model_cfg, trial: TrialConfig):
+    """A new ``ModelConfig`` with the trial's model-side knobs applied
+    (decoder remat/policy/scan_chunks, Pallas block grid)."""
+    decoder = dataclasses.replace(
+        model_cfg.decoder,
+        remat=trial.remat,
+        remat_policy=trial.remat_policy,
+        scan_chunks=trial.scan_chunks,
+    )
+    gnn = dataclasses.replace(
+        model_cfg.gnn,
+        pallas_fwd_blocks=trial.pallas_fwd_blocks,
+        pallas_bwd_blocks=trial.pallas_bwd_blocks,
+    )
+    return dataclasses.replace(model_cfg, decoder=decoder, gnn=gnn)
+
+
+def apply_to_loop_config(loop_cfg, trial: TrialConfig):
+    """A new ``LoopConfig`` with the trial's loop-side knobs applied."""
+    return dataclasses.replace(loop_cfg, steps_per_dispatch=trial.scan_k)
+
+
+def apply_to_optim_config(optim_cfg, trial: TrialConfig):
+    return dataclasses.replace(optim_cfg, accumulate_steps=trial.microbatch)
+
+
+def model_signature(model_cfg) -> str:
+    """Stable signature of the ARCHITECTURE a tuning entry applies to.
+
+    Deliberately excludes the tunable axes themselves (remat, scan_chunks,
+    Pallas blocks) — a tuned and a default build of the same model must
+    share one store entry — and includes everything that changes the
+    compiled graphs' math: layer counts, widths, heads, decoder
+    chunks/channels, compute dtype, attention mode, module type."""
+    g, d = model_cfg.gnn, model_cfg.decoder
+    return (
+        f"{model_cfg.gnn_layer_type}-{model_cfg.interact_module_type}"
+        f"-gl{g.num_layers}h{g.hidden}a{g.num_heads}-{g.attention_mode}"
+        f"-il{d.num_chunks}c{d.num_channels}-{d.compute_dtype}"
+        + ("-tiled" if model_cfg.tile_pair_map else "")
+    )
+
+
+def bucket_key(batch: int, pad: int) -> str:
+    return f"b{batch}_p{pad}"

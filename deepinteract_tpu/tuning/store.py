@@ -1,0 +1,204 @@
+"""Versioned on-disk tuning store: JSON, atomic writes, replicated reads.
+
+Schema (``SCHEMA_VERSION`` = 1)::
+
+    {
+      "schema_version": 1,
+      "created": <wall-clock s of first write>,
+      "entries": {
+        "<device_kind>|<jax_version>|<model_signature>|<bucket>": {
+          "config":  {<TrialConfig fields>},      # the winning config
+          "objective": "train_scan_ms_per_step",  # what was minimized
+          "value": 12.3,                          # winner's objective
+          "default_value": 15.0,                  # default config's objective
+          "trials_completed": 9,
+          "trials_total": 12,
+          "partial": false,     # true when the search died early; the
+                                # entry is still the best of what finished
+          "measured_at": <wall-clock s>,
+          "search": {...}       # rung/budget bookkeeping, for forensics
+        }
+      }
+    }
+
+Key design points, each earned the hard way:
+
+* **Atomic, incremental writes.** ``put`` + ``save`` rewrite the whole
+  file via tmp+``os.replace`` after EVERY trial, so a killed or timed-out
+  tuning run keeps everything measured so far (the rc=124 lesson from
+  BENCH_r03/r04: a whole driver round died with finished work unrecorded).
+* **Versioned and loudly incompatible.** A store whose ``schema_version``
+  differs is rejected with :class:`StoreSchemaError`, never silently
+  reinterpreted — a stale schema feeding the Trainer wrong knobs would be
+  a silent performance (or OOM) regression.
+* **Keyed by everything that invalidates a measurement**: device kind
+  (block sizes that win on v5e lose on v4), jax version (compiler
+  changes), model signature (a different architecture is a different
+  search), bucket (scan_k that wins at b1 loses at b8).
+* **Replicated read path.** Multi-host consumers read through
+  :meth:`TuningStore.load_replicated`: host 0 reads the bytes and
+  broadcasts them, so every host adopts IDENTICAL configs even when the
+  store lives on host-local disk — hosts disagreeing on scan_k would
+  compile different scan lengths and deadlock the first collective.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from deepinteract_tpu.tuning.space import TrialConfig
+
+SCHEMA_VERSION = 1
+
+DEFAULT_STORE_BASENAME = "tuning_store.json"
+
+
+class StoreSchemaError(ValueError):
+    """The on-disk store's schema_version is not ours."""
+
+
+def entry_key(device_kind: str, jax_version: str, model_signature: str,
+              bucket: str) -> str:
+    return f"{device_kind}|{jax_version}|{model_signature}|{bucket}"
+
+
+def runtime_key(model_signature: str, bucket: str) -> str:
+    """The entry key for THIS process's device + jax version."""
+    import jax
+
+    return entry_key(jax.devices()[0].device_kind, jax.__version__,
+                     model_signature, bucket)
+
+
+class TuningStore:
+    """Load/modify/save wrapper over the schema above. All mutation goes
+    through :meth:`put` + :meth:`save`; readers use :meth:`get` /
+    :meth:`best_config`."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.data: Dict = {
+            "schema_version": SCHEMA_VERSION,
+            "created": time.time(),
+            "entries": {},
+        }
+
+    # -- I/O ---------------------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str) -> "TuningStore":
+        """Read an existing store; raises StoreSchemaError on a version
+        mismatch and OSError/ValueError on a missing/corrupt file."""
+        with open(path) as fh:
+            data = json.load(fh)
+        return cls._from_payload(path, data)
+
+    @classmethod
+    def _from_payload(cls, path: str, data: Dict) -> "TuningStore":
+        version = data.get("schema_version")
+        if version != SCHEMA_VERSION:
+            raise StoreSchemaError(
+                f"tuning store {path}: schema_version {version!r} != "
+                f"supported {SCHEMA_VERSION}; re-run cli.tune to regenerate"
+            )
+        if not isinstance(data.get("entries"), dict):
+            raise ValueError(f"tuning store {path}: malformed 'entries'")
+        store = cls(path)
+        store.data = data
+        return store
+
+    @classmethod
+    def load_or_create(cls, path: str) -> "TuningStore":
+        if os.path.exists(path):
+            return cls.load(path)
+        return cls(path)
+
+    @classmethod
+    def load_replicated(cls, path: str) -> Optional["TuningStore"]:
+        """Multi-host-safe read: process 0 reads (or fails) and broadcasts
+        the bytes; every host parses the SAME payload. Returns None when
+        the store does not exist on host 0 (on every host). Schema errors
+        still raise — on all hosts, identically."""
+        import jax
+
+        if jax.process_count() <= 1:
+            if not os.path.exists(path):
+                return None
+            return cls.load(path)
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        raw = b""
+        if jax.process_index() == 0 and os.path.exists(path):
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        # Length-prefixed fixed-width broadcast (broadcast_one_to_all needs
+        # same-shape arrays on every host).
+        n = multihost_utils.broadcast_one_to_all(
+            np.asarray([len(raw)], dtype=np.int64))
+        size = int(n[0])
+        if size == 0:
+            return None
+        buf = np.zeros(size, dtype=np.uint8)
+        if jax.process_index() == 0:
+            buf[:] = np.frombuffer(raw, dtype=np.uint8)
+        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf),
+                         dtype=np.uint8)
+        data = json.loads(bytes(buf.tobytes()).decode("utf-8"))
+        return cls._from_payload(path, data)
+
+    def save(self) -> None:
+        """Atomic whole-file rewrite (tmp + rename): a kill mid-save
+        leaves the previous version intact, never a torn file."""
+        directory = os.path.dirname(self.path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.data, fh, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    # -- entries -----------------------------------------------------------
+
+    def put(self, key: str, entry: Dict) -> None:
+        self.data["entries"][key] = entry
+
+    def get(self, key: str) -> Optional[Dict]:
+        return self.data["entries"].get(key)
+
+    def keys(self):
+        return list(self.data["entries"])
+
+    def best_config(self, model_signature: str, bucket: str,
+                    ) -> Optional[TrialConfig]:
+        """The winning TrialConfig for this process's device/jax version,
+        or None when nothing was tuned for that key."""
+        entry = self.get(runtime_key(model_signature, bucket))
+        if entry is None or "config" not in entry:
+            return None
+        return TrialConfig.from_dict(entry["config"])
+
+    def best_entry_any_bucket(self, model_signature: str) -> Optional[Dict]:
+        """Fallback lookup: any bucket's entry for this device + model —
+        used by consumers whose active bucket was never tuned (adopting a
+        neighboring bucket's remat/scan_chunks beats hardcoded guesses;
+        scan_k transfers less well, which callers note when they fall
+        back)."""
+        import jax
+
+        prefix = (f"{jax.devices()[0].device_kind}|{jax.__version__}|"
+                  f"{model_signature}|")
+        for key, entry in sorted(self.data["entries"].items()):
+            if key.startswith(prefix):
+                return entry
+        return None
+
+
+def default_store_path(ckpt_dir: Optional[str]) -> str:
+    """Where the store lives when ``--tuning_store`` is unset: next to the
+    checkpoints (the run's durable artifact directory), falling back to
+    the working directory."""
+    return os.path.join(ckpt_dir or ".", DEFAULT_STORE_BASENAME)

@@ -1,0 +1,105 @@
+"""Fast-tier wiring of tools/check_bench_contract.py: the driver parses
+the LAST line of its bench capture as the contract JSON, and twice
+(BENCH_r01, BENCH_r05) a finished run landed ``"parsed": null`` because
+something else was printed last. These tests make that un-regressable —
+including against bench.py's real headline builder, so a key rename there
+fails here first."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.check_bench_contract import check_contract_text  # noqa: E402
+
+GOOD = json.dumps({"metric": "train_complexes_per_sec_b1_p128_scan8",
+                   "value": 33.0, "unit": "complexes/s",
+                   "vs_baseline": 14.8})
+
+
+def test_valid_contract_line_passes():
+    record = check_contract_text(f"noise\nmore noise\n{GOOD}\n")
+    assert record["value"] == 33.0
+
+
+def test_partial_marker_accepted():
+    line = json.dumps({"metric": "m", "value": 1.0, "unit": "u",
+                       "vs_baseline": 0.5, "partial": True})
+    assert check_contract_text(line)["partial"] is True
+
+
+def test_detail_dump_last_is_rejected():
+    """The BENCH_r05 regression: the stderr DETAIL dump as the final
+    line. It IS valid JSON after the 'DETAIL ' prefix — the prefix is
+    exactly why parsing failed."""
+    text = GOOD + "\nDETAIL " + json.dumps({"buckets": {}})
+    with pytest.raises(ValueError, match="not JSON"):
+        check_contract_text(text)
+
+
+def test_missing_keys_rejected():
+    with pytest.raises(ValueError, match="missing keys"):
+        check_contract_text(json.dumps({"metric": "m", "value": 1.0}))
+
+
+def test_non_numeric_value_rejected():
+    with pytest.raises(ValueError, match="must be a number"):
+        check_contract_text(json.dumps(
+            {"metric": "m", "value": "fast", "unit": "u",
+             "vs_baseline": 1.0}))
+
+
+def test_empty_capture_rejected():
+    with pytest.raises(ValueError, match="empty"):
+        check_contract_text("\n\n")
+
+
+def test_bench_headline_builder_satisfies_contract():
+    """bench.py's own _build_headline output must parse — success, failed
+    headline bucket (value 0), and partial-run variants."""
+    import bench
+
+    full = {"buckets": {"b1_p128": {
+        "batch": 1,
+        "train_scan_complexes_per_sec": 33.0,
+        "train_scan_ms_per_step": 30.0,
+        "train_scan_ms_per_step_min": 29.0,
+        "scan_timing_protocol": {"clamped_samples": 0},
+    }}}
+    record = check_contract_text(json.dumps(bench._build_headline(full, 8)))
+    assert record["metric"].endswith("scan8")
+    assert "partial" not in record
+
+    failed = {"buckets": {}}
+    record = check_contract_text(json.dumps(bench._build_headline(failed, 8)))
+    assert record["value"] == 0.0
+
+    partial = {"buckets": {"b1_p128": full["buckets"]["b1_p128"],
+                           "b1_p256": {"skipped": "wall budget"}}}
+    record = check_contract_text(
+        json.dumps(bench._build_headline(partial, 8)))
+    assert record["partial"] is True
+
+
+def test_cli_tool_end_to_end(tmp_path):
+    log = tmp_path / "capture.log"
+    log.write_text("compile...\n" + GOOD + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_bench_contract.py"),
+         str(log)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["contract_ok"] is True
+
+    log.write_text(GOOD + "\nDETAIL {}\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_bench_contract.py"),
+         str(log)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "CONTRACT VIOLATION" in proc.stderr

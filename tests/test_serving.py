@@ -52,11 +52,39 @@ def fresh_raw(seed, n1=20, n2=16):
 
 
 @pytest.fixture(scope="module")
-def engine():
+def tuning_store_path(tmp_path_factory):
+    """A persisted tuning store whose entry matches the module engine's
+    active bucket (no warmup specs -> top bucket at batch 1). The tuned
+    knobs are graph-neutral for the tiny config (num_chunks=1 makes
+    scan_chunks moot; hidden=16 routes off the Pallas kernel), so every
+    other test in this module doubles as 'adoption changes nothing it
+    should not'."""
+    from deepinteract_tpu.tuning.space import (
+        TrialConfig,
+        bucket_key,
+        model_signature,
+    )
+    from deepinteract_tpu.tuning.store import TuningStore, runtime_key
+
+    path = str(tmp_path_factory.mktemp("tuning") / "tuning_store.json")
+    store = TuningStore(path)
+    store.put(
+        runtime_key(model_signature(tiny_model_cfg()), bucket_key(1, 256)),
+        {"config": TrialConfig(remat=True, scan_k=4, scan_chunks=False,
+                               pallas_fwd_blocks=2).to_dict(),
+         "objective": "train_scan_ms_per_step", "value": 2.0,
+         "partial": False})
+    store.save()
+    return path
+
+
+@pytest.fixture(scope="module")
+def engine(tuning_store_path):
     eng = InferenceEngine(
         tiny_model_cfg(),
         cfg=EngineConfig(max_batch=8, max_delay_ms=25.0,
-                         result_cache_size=64),
+                         result_cache_size=64,
+                         tuning_store=tuning_store_path),
     )
     yield eng
     eng.close()
@@ -195,6 +223,24 @@ def test_warm_bucket_triggers_zero_new_traces(engine):
     # A different shape signature (new lengths -> same bucket) still warm;
     # probabilities are well-formed.
     assert np.all(out2["probs"] >= 0) and np.all(out2["probs"] <= 1)
+
+
+def test_engine_adopted_tuning_store(engine, tuning_store_path):
+    """The engine resolved the tuned config for its active bucket at
+    construction (before any AOT compile), applied the forward-safe knobs,
+    and reports the adoption in /stats. Runs against the SAME module
+    engine whose warm path the trace-count test above just pinned — so
+    adoption + zero-retrace hold together, on one engine."""
+    assert engine.adopted_tuning is not None
+    assert engine.adopted_tuning.source == "exact"
+    # scan_chunks applied (no checkpoint pins the layout); Pallas grid
+    # threaded into the model config.
+    assert engine.model.cfg.decoder.scan_chunks is False
+    assert engine.model.cfg.gnn.pallas_fwd_blocks == 2
+    stats = engine.stats()
+    assert stats["tuning"]["store"] == tuning_store_path
+    assert "scan_chunks=False" in stats["tuning"]["adopted"]
+    assert "remat=full" in stats["tuning"]["adopted"]
 
 
 def test_result_cache_returns_identical_map_without_device_work(engine):

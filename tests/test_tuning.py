@@ -1,0 +1,598 @@
+"""Autotuning subsystem tests (tuning/): store roundtrip + schema
+rejection, deterministic successive halving on a fake timer, hard
+per-trial deadline and kill-safety of the incremental store, trainer and
+serving-engine adoption, the dry-run CLI, and the Pallas block axis.
+
+Everything here is CPU-fast: real device measurement is the tuner's
+production path, but every piece of SELECTION/PERSISTENCE/ADOPTION logic
+is exercised against injected measure functions (the whole point of the
+measure-fn seam)."""
+
+import dataclasses
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+from deepinteract_tpu.tuning import consume
+from deepinteract_tpu.tuning.search import SuccessiveHalvingSearch
+from deepinteract_tpu.tuning.space import (
+    TrialConfig,
+    axes_for_bucket,
+    bucket_key,
+    canonicalize,
+    default_trial,
+    enumerate_trials,
+    model_signature,
+)
+from deepinteract_tpu.tuning.store import (
+    SCHEMA_VERSION,
+    StoreSchemaError,
+    TuningStore,
+    runtime_key,
+)
+
+
+def tiny_model_cfg():
+    return ModelConfig(
+        gnn=GTConfig(num_layers=2, hidden=16, num_heads=2, shared_embed=8,
+                     dropout_rate=0.0),
+        decoder=DecoderConfig(num_chunks=1, num_channels=8,
+                              dilation_cycle=(1,)),
+    )
+
+
+def make_entry(config: TrialConfig, value=1.0, partial=False):
+    return {"config": config.to_dict(), "objective": "train_scan_ms_per_step",
+            "value": value, "partial": partial, "trials_completed": 1,
+            "trials_total": 1, "measured_at": time.time()}
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def test_store_roundtrip_atomic(tmp_path):
+    path = str(tmp_path / "store.json")
+    store = TuningStore(path)
+    cfg = TrialConfig(remat=True, scan_k=4, pallas_fwd_blocks=2)
+    key = runtime_key("sig", "b1_p64")
+    store.put(key, make_entry(cfg, value=3.25))
+    store.save()
+    assert not os.path.exists(path + ".tmp")  # atomic rename, no leftovers
+
+    loaded = TuningStore.load(path)
+    assert loaded.data["schema_version"] == SCHEMA_VERSION
+    entry = loaded.get(key)
+    assert entry["value"] == 3.25
+    assert TrialConfig.from_dict(entry["config"]) == cfg
+    # best_config resolves through the runtime key for THIS device/jax.
+    assert loaded.best_config("sig", "b1_p64") == cfg
+    assert loaded.best_config("sig", "b1_p128") is None
+
+
+def test_store_schema_version_rejected(tmp_path):
+    path = tmp_path / "store.json"
+    path.write_text(json.dumps({"schema_version": SCHEMA_VERSION + 1,
+                                "entries": {}}))
+    with pytest.raises(StoreSchemaError, match="schema_version"):
+        TuningStore.load(str(path))
+    # Consumers must fail loudly too, not silently skip adoption.
+    with pytest.raises(StoreSchemaError):
+        consume.lookup_path(str(path), tiny_model_cfg(), 1, 64)
+
+
+def test_store_malformed_entries_rejected(tmp_path):
+    path = tmp_path / "store.json"
+    path.write_text(json.dumps({"schema_version": SCHEMA_VERSION,
+                                "entries": []}))
+    with pytest.raises(ValueError, match="entries"):
+        TuningStore.load(str(path))
+
+
+def test_lookup_bucket_fallback_drops_scan_k(tmp_path):
+    """A neighboring bucket's entry transfers model-side knobs only."""
+    from deepinteract_tpu.training.loop import LoopConfig
+
+    path = str(tmp_path / "store.json")
+    store = TuningStore(path)
+    sig = model_signature(tiny_model_cfg())
+    tuned = TrialConfig(remat=True, scan_k=16, scan_chunks=False)
+    store.put(runtime_key(sig, "b1_p64"), make_entry(tuned))
+    store.save()
+
+    exact = consume.lookup_path(path, tiny_model_cfg(), 1, 64)
+    assert exact.source == "exact" and exact.scan_k_applies
+
+    fb = consume.lookup_path(path, tiny_model_cfg(), 8, 128)
+    assert fb.source == "bucket_fallback" and not fb.scan_k_applies
+    loop = consume.adopt_loop_config(LoopConfig(steps_per_dispatch=8), fb)
+    assert loop.steps_per_dispatch == 8  # scan_k kept
+    model_cfg = consume.adopt_model_config(tiny_model_cfg(), fb)
+    assert model_cfg.decoder.remat is True
+    assert model_cfg.decoder.scan_chunks is False
+    assert "kept-default" in fb.summary()
+
+
+# ---------------------------------------------------------------------------
+# space
+# ---------------------------------------------------------------------------
+
+
+def test_space_enumeration_default_first_dedup():
+    axes = axes_for_bucket(1, 128, "cpu", include_loader_axis=True)
+    trials = enumerate_trials(axes, max_trials=64)
+    assert trials[0] == canonicalize(default_trial())
+    assert len(set(trials)) == len(trials)  # deduplicated
+    # remat=False collapses the remat_policy axis — no duplicated configs
+    # differing only in a dead field.
+    assert all(t.remat_policy == "full" for t in trials if not t.remat)
+
+
+def test_space_p256_forces_remat():
+    axes = {a.name: a for a in axes_for_bucket(1, 256, "cpu")}
+    assert axes["remat"].values == (True,)
+
+
+def test_pallas_block_axis_on_tpu_kind_only():
+    cpu_axes = {a.name for a in axes_for_bucket(1, 256, "cpu")}
+    tpu_axes = {a.name for a in axes_for_bucket(1, 256, "TPU v5 lite")}
+    assert "pallas_fwd_blocks" not in cpu_axes
+    assert "pallas_fwd_blocks" in tpu_axes and "pallas_bwd_blocks" in tpu_axes
+
+
+def test_pallas_edge_block_options_legal():
+    from deepinteract_tpu.ops.pallas_attention import edge_block_options
+
+    for n in (64, 128, 192, 256):
+        for backward in (False, True):
+            opts = edge_block_options(n, 20, backward=backward)
+            assert opts, (n, backward)
+            for nb in opts:
+                e = n * 20
+                assert e % nb == 0
+
+
+def test_pallas_block_override_parity_interpret():
+    """Tuned block grids change accumulation order only (tolerance-level
+    parity with the heuristic grid), forward and backward."""
+    import jax.numpy as jnp
+
+    from deepinteract_tpu.ops.pallas_attention import edge_attention_pallas
+
+    rng = np.random.default_rng(0)
+    # Smallest shape that still exercises multi-block accumulation
+    # (e = 128 edges split 2/4 ways) — interpret-mode compile time is
+    # quick-tier wall budget.
+    b, n, h, d, kk = 1, 32, 2, 8, 4
+    mk = lambda *s: jnp.asarray(rng.normal(size=s), jnp.float32)  # noqa: E731
+    q, k, v = mk(b, n, h, d), mk(b, n, h, d), mk(b, n, h, d)
+    pe = mk(b, n, kk, h, d)
+    nbr = jnp.asarray(rng.integers(0, n, size=(b, n, kk)), jnp.int32)
+    mask = jnp.ones((b, n, kk), jnp.float32)
+
+    h0, e0 = edge_attention_pallas(q, k, v, pe, nbr, mask, True)
+    h2, e2 = edge_attention_pallas(q, k, v, pe, nbr, mask, True, 2, 4)
+    np.testing.assert_allclose(h0, h2, atol=1e-5)
+    np.testing.assert_allclose(e0, e2, atol=1e-5)
+
+    def loss(qq, fb, bb):
+        ho, eo = edge_attention_pallas(qq, k, v, pe, nbr, mask, True, fb, bb)
+        return (ho ** 2).sum() + (eo ** 2).sum()
+
+    g0 = jax.grad(lambda qq: loss(qq, None, None))(q)
+    g2 = jax.grad(lambda qq: loss(qq, 2, 4))(q)
+    np.testing.assert_allclose(g0, g2, atol=1e-4)
+
+    with pytest.raises(ValueError, match="block count"):
+        edge_attention_pallas(q, k, v, pe, nbr, mask, True, 7, None)
+
+
+# ---------------------------------------------------------------------------
+# successive halving on a fake timer
+# ---------------------------------------------------------------------------
+
+
+def fake_measure(costs):
+    """Deterministic fake timer: cost by scan_k (plus a per-call log)."""
+    calls = []
+
+    def measure(trial, fidelity):
+        calls.append((trial.label(), fidelity))
+        return costs[trial.scan_k], {"fidelity": fidelity}
+
+    measure.calls = calls
+    return measure
+
+
+def test_successive_halving_deterministic(tmp_path):
+    costs = {1: 30.0, 4: 9.0, 8: 6.0, 16: 4.0}
+    trials = [TrialConfig(scan_k=k) for k in (1, 4, 8, 16)]
+
+    def run_once(path):
+        store = TuningStore(str(path))
+        search = SuccessiveHalvingSearch(
+            fake_measure(costs), store=store,
+            store_key=runtime_key("sig", "b1_p64"),
+            eta=2, base_fidelity=3, max_rungs=3,
+            install_signal_handlers=False)
+        return search, search.run(trials)
+
+    s1, r1 = run_once(tmp_path / "a.json")
+    s2, r2 = run_once(tmp_path / "b.json")
+    assert r1.best == r2.best == TrialConfig(scan_k=16)
+    assert r1.best_value == 4.0 and not r1.partial
+    # Rung structure: 4 trials at rung 0, top-2 at rung 1, top-1 at rung 2.
+    assert [t.rung for t in r1.results] == [0, 0, 0, 0, 1, 1, 2]
+    # Fidelity grows eta-fold per rung.
+    assert [t.fidelity for t in r1.results] == [3, 3, 3, 3, 6, 6, 12]
+    # Same trial sequence both runs — fully deterministic.
+    assert s1.measure.calls == s2.measure.calls
+    # default (scan_k=8) was measured, so the entry carries the baseline.
+    entry = TuningStore.load(str(tmp_path / "a.json")).get(
+        runtime_key("sig", "b1_p64"))
+    assert entry["config"]["scan_k"] == 16
+    assert entry["default_value"] == 6.0
+    assert entry["partial"] is False
+    assert entry["trials_completed"] == 7
+
+
+def test_failed_configs_are_data_not_fatal(tmp_path):
+    def measure(trial, fidelity):
+        if trial.remat:
+            raise RuntimeError("injected compile OOM")
+        return 5.0 + trial.scan_k * 0.1, {}
+
+    trials = [TrialConfig(scan_k=1), TrialConfig(scan_k=1, remat=True),
+              TrialConfig(scan_k=4)]
+    search = SuccessiveHalvingSearch(measure, max_rungs=1,
+                                     install_signal_handlers=False)
+    result = search.run(trials)
+    statuses = [r.status for r in result.results]
+    assert statuses == ["ok", "error", "ok"]
+    assert result.best == TrialConfig(scan_k=1)
+    assert "OOM" in result.results[1].error
+
+
+def test_hard_trial_deadline_records_timeout(tmp_path):
+    def measure(trial, fidelity):
+        if trial.scan_k == 4:
+            time.sleep(5.0)  # killed by SIGALRM far earlier
+        return float(trial.scan_k), {}
+
+    store = TuningStore(str(tmp_path / "s.json"))
+    key = runtime_key("sig", "b1_p64")
+    trials = [TrialConfig(scan_k=1), TrialConfig(scan_k=4),
+              TrialConfig(scan_k=8)]
+    t0 = time.monotonic()
+    search = SuccessiveHalvingSearch(
+        measure, store=store, store_key=key, max_rungs=1,
+        trial_deadline_s=0.3, install_signal_handlers=False)
+    result = search.run(trials)
+    assert time.monotonic() - t0 < 4.0  # the sleep was actually interrupted
+    assert [r.status for r in result.results] == ["ok", "timeout", "ok"]
+    # The store is readable and carries every COMPLETED trial.
+    entry = TuningStore.load(store.path).get(key)
+    assert entry["trials_completed"] == 2
+    statuses = [t["status"] for t in entry["trial_log"]]
+    assert statuses == ["ok", "timeout", "ok"]
+
+
+def test_sigterm_mid_search_leaves_readable_partial_store(tmp_path):
+    """The acceptance criterion: killing a tuning run mid-search leaves a
+    readable store containing every completed trial."""
+    fired = []
+
+    def measure(trial, fidelity):
+        if len(fired) == 1:  # second trial: the "operator" sends SIGTERM
+            signal.raise_signal(signal.SIGTERM)
+        fired.append(trial.label())
+        return float(trial.scan_k), {}
+
+    store = TuningStore(str(tmp_path / "s.json"))
+    key = runtime_key("sig", "b1_p64")
+    trials = [TrialConfig(scan_k=k) for k in (8, 4, 1, 16)]
+    search = SuccessiveHalvingSearch(
+        measure, store=store, store_key=key, max_rungs=2,
+        install_signal_handlers=True)
+    result = search.run(trials)
+    assert result.partial
+    assert "SIGTERM" in (result.stopped_reason or "")
+    # The in-flight trial finished, nothing after it started.
+    assert len(fired) == 2
+    entry = TuningStore.load(store.path).get(key)
+    assert entry["partial"] is True
+    assert entry["trials_completed"] == 2
+    assert entry["config"]["scan_k"] == 4  # best of what completed
+    # SIGTERM handling is restored afterwards (default disposition).
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_second_signal_escalates_to_immediate_abort(tmp_path):
+    """First SIGTERM = cooperative stop after the in-flight trial; second
+    = immediate abort (a trial wedged in native code would never reach
+    the cooperative stop point). The store already holds every completed
+    trial, so nothing is lost."""
+    first_done = []
+
+    def measure(trial, fidelity):
+        if not first_done:
+            first_done.append(True)
+            return 1.0, {}
+        signal.raise_signal(signal.SIGTERM)  # cooperative stop requested
+        signal.raise_signal(signal.SIGTERM)  # operator means NOW
+        return 2.0, {}
+
+    store = TuningStore(str(tmp_path / "s.json"))
+    key = runtime_key("sig", "b1_p64")
+    search = SuccessiveHalvingSearch(
+        measure, store=store, store_key=key, max_rungs=1,
+        install_signal_handlers=True)
+    with pytest.raises(KeyboardInterrupt, match="aborting immediately"):
+        search.run([TrialConfig(scan_k=k) for k in (8, 4, 1)])
+    entry = TuningStore.load(store.path).get(key)
+    assert entry["trials_completed"] == 1  # trial 1 survived the abort
+    assert entry["partial"] is True
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL  # restored
+
+
+def test_store_valid_after_every_trial(tmp_path):
+    """Incremental persistence: the on-disk store parses (and carries all
+    prior completed trials) at EVERY trial boundary, not just at the end."""
+    store = TuningStore(str(tmp_path / "s.json"))
+    key = runtime_key("sig", "b1_p64")
+    observed = []
+
+    def measure(trial, fidelity):
+        if os.path.exists(store.path):
+            entry = TuningStore.load(store.path).get(key)
+            observed.append(entry["trials_completed"])
+        return float(trial.scan_k), {}
+
+    trials = [TrialConfig(scan_k=k) for k in (8, 4, 1)]
+    SuccessiveHalvingSearch(measure, store=store, store_key=key, max_rungs=1,
+                            install_signal_handlers=False).run(trials)
+    assert observed == [1, 2]  # trial N sees N completed predecessors
+
+
+def test_failed_refresh_never_clobbers_previous_winner(tmp_path):
+    """A re-tune whose trials all fail must keep the previously measured
+    winner (attaching the failed search's record), not replace it with a
+    config-less entry that silently falls consumers back to defaults."""
+    store = TuningStore(str(tmp_path / "s.json"))
+    key = runtime_key("sig", "b1_p64")
+    old = TrialConfig(scan_k=16)
+    store.put(key, make_entry(old, value=4.0))
+    store.save()
+
+    def measure(trial, fidelity):
+        raise RuntimeError("transport down")
+
+    SuccessiveHalvingSearch(
+        measure, store=store, store_key=key, max_rungs=1,
+        install_signal_handlers=False).run([TrialConfig(scan_k=8)])
+    entry = TuningStore.load(store.path).get(key)
+    assert TrialConfig.from_dict(entry["config"]) == old  # winner kept
+    assert entry["value"] == 4.0
+    assert entry["last_failed_search"]["trials_completed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# consumers
+# ---------------------------------------------------------------------------
+
+
+def test_restrict_pallas_blocks_checks_every_pad():
+    """The tuned grid applies only when legal at EVERY padded chain
+    length the consumer can compile — the kernel runs at each chain's own
+    pad, and an indivisible block count is a trace-time error."""
+    adopted = consume.Adopted(
+        config=TrialConfig(pallas_fwd_blocks=3), key="k", source="exact")
+    # 3 divides 192*20 — legal for a pure-192 plan.
+    kept, note = consume.restrict_pallas_blocks(adopted, {192}, knn=20)
+    assert kept.config.pallas_fwd_blocks == 3 and note == ""
+    # ...but 2560 % 3 != 0: a plan that also compiles pad 128 (e.g. the
+    # other chain of a (128, 192) bucket) must drop the grid.
+    stripped, note = consume.restrict_pallas_blocks(adopted, {128, 192},
+                                                    knn=20)
+    assert stripped.config.pallas_fwd_blocks is None
+    assert "NOT applied" in note
+    # Other knobs survive the strip; a grid-free adoption passes through.
+    assert stripped.config.scan_k == adopted.config.scan_k
+    noop, note = consume.restrict_pallas_blocks(
+        consume.Adopted(config=TrialConfig(), key="k", source="exact"),
+        {128}, knn=20)
+    assert note == ""
+    assert consume.restrict_pallas_blocks(None, {128})[0] is None
+
+
+def test_trainer_adopts_store_entry(tmp_path):
+    """Trainer resolves scan_k (+ the model config resolves remat) from
+    the store at startup and logs the adopted tuple. (No fit here: the
+    scanned dispatch the adopted scan_k selects is the code path
+    test_training_loop already pins, and a fit's compile time would eat
+    the quick tier's wall budget.)"""
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    base_cfg = tiny_model_cfg()
+    path = str(tmp_path / "store.json")
+    store = TuningStore(path)
+    tuned = TrialConfig(remat=True, scan_k=2, scan_chunks=True)
+    store.put(runtime_key(model_signature(base_cfg), bucket_key(1, 24)),
+              make_entry(tuned, value=2.0))
+    store.save()
+
+    adopted = consume.lookup_path(path, base_cfg, 1, 24)
+    model_cfg = consume.adopt_model_config(base_cfg, adopted)
+    assert model_cfg.decoder.remat is True  # model-side knob landed
+
+    logs = []
+    loop_cfg = LoopConfig(num_epochs=1, steps_per_dispatch=8, log_every=0,
+                          autotune=True, tuning_store=path,
+                          tuning_bucket=(1, 24), span_log=False)
+    trainer = Trainer(DeepInteract(model_cfg), loop_cfg,
+                      OptimConfig(steps_per_epoch=2, num_epochs=1),
+                      log_fn=logs.append)
+    assert trainer.cfg.steps_per_dispatch == 2  # tuned scan_k adopted
+    assert trainer.adopted_tuning is not None
+    assert any("autotune: adopted" in m and "scan_k=2" in m for m in logs)
+
+
+def test_trainer_missing_entry_keeps_defaults(tmp_path):
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    path = str(tmp_path / "store.json")
+    TuningStore(path).save()  # valid but empty
+    logs = []
+    trainer = Trainer(
+        DeepInteract(tiny_model_cfg()),
+        LoopConfig(num_epochs=1, steps_per_dispatch=8, autotune=True,
+                   tuning_store=path, tuning_bucket=(1, 24), span_log=False),
+        OptimConfig(steps_per_epoch=2, num_epochs=1), log_fn=logs.append)
+    assert trainer.cfg.steps_per_dispatch == 8
+    assert trainer.adopted_tuning is None
+    assert any("no tuning-store entry" in m for m in logs)
+
+
+# NOTE: the live-engine adoption test (tuned store resolved at
+# construction + zero-retrace warm path, asserted via trace_count) lives
+# in tests/test_serving.py::test_engine_adopted_tuning_store — it rides
+# that module's SHARED compiled engine, so it costs the quick tier no
+# additional engine build. This module keeps the engine-free policy
+# tests below.
+
+
+def test_serving_engine_keeps_scan_chunks_with_checkpoint(tmp_path):
+    """A checkpoint pins the param-tree layout: tuned scan_chunks must NOT
+    be applied over it (adoption applies the safe subset and notes what it
+    kept). Exercised on the adoption method directly — constructing a
+    whole engine (jitted init + compiles) would buy nothing for this
+    config-level decision and costs real quick-tier wall time."""
+    from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+
+    base_cfg = tiny_model_cfg()
+    path = str(tmp_path / "store.json")
+    store = TuningStore(path)
+    store.put(runtime_key(model_signature(base_cfg), bucket_key(1, 64)),
+              make_entry(TrialConfig(scan_chunks=False,
+                                     pallas_fwd_blocks=2)))
+    store.save()
+
+    def adopt(ckpt_dir):
+        shell = object.__new__(InferenceEngine)
+        shell.cfg = EngineConfig(warmup_buckets=((64, 64, 1),),
+                                 tuning_store=path)
+        shell.adopted_tuning = None
+        return shell, InferenceEngine._adopt_tuned(shell, base_cfg, ckpt_dir)
+
+    shell, cfg = adopt(ckpt_dir=str(tmp_path / "ckpt"))
+    assert shell.adopted_tuning is not None
+    assert cfg.decoder.scan_chunks is True  # layout kept under a ckpt
+    assert cfg.gnn.pallas_fwd_blocks == 2  # safe knobs still adopted
+
+    shell, cfg = adopt(ckpt_dir=None)
+    assert cfg.decoder.scan_chunks is False  # no ckpt -> tuned layout
+
+
+# ---------------------------------------------------------------------------
+# CLI + compile cache
+# ---------------------------------------------------------------------------
+
+
+def test_tune_cli_dry_run_emits_valid_store(tmp_path, capsys):
+    """The CI criterion: `cli.tune --dry_run` produces a valid persisted
+    store, and its final stdout line is machine-readable JSON."""
+    from deepinteract_tpu.cli.tune import main
+
+    ckpt_dir = str(tmp_path / "run")
+    rc = main(["--dry_run", "--ckpt_dir", ckpt_dir,
+               "--tune_buckets", "1x64,1x128", "--max_trials", "8",
+               "--compile_cache_dir", "off"])
+    assert rc == 0
+    store = TuningStore.load(os.path.join(ckpt_dir, "tuning_store.json"))
+    assert len(store.keys()) == 2
+    for key in store.keys():
+        entry = store.get(key)
+        assert entry["synthetic"] is True
+        assert entry["partial"] is False
+        assert "config" in entry and "value" in entry
+        # The entry round-trips into a TrialConfig consumers can adopt.
+        TrialConfig.from_dict(entry["config"])
+    last = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.strip()][-1]
+    summary = json.loads(last)
+    assert summary["dry_run"] is True
+    assert set(summary["buckets"]) == {"b1_p64", "b1_p128"}
+    for row in summary["buckets"].values():
+        assert row["best"] is not None
+        assert row["speedup_vs_default"] is not None
+
+
+def test_tuning_trials_are_observable():
+    """Trials emit di_tuning_* counter increments and tuning_trial spans."""
+    from deepinteract_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.get_registry()
+    trials_counter = obs_metrics.counter("di_tuning_trials_total",
+                                         labelnames=("status",))
+    before = trials_counter.value(status="ok")
+    SuccessiveHalvingSearch(
+        lambda t, f: (1.0, {}), max_rungs=1,
+        install_signal_handlers=False).run([TrialConfig(scan_k=8)])
+    assert trials_counter.value(status="ok") == before + 1
+    hist = obs_metrics.histogram("di_tuning_trial_seconds")
+    assert hist.count() >= 1
+    assert reg is obs_metrics.get_registry()
+
+
+def test_compile_cache_resolution(tmp_path):
+    from deepinteract_tpu.tuning.compile_cache import resolve_cache_dir
+
+    assert resolve_cache_dir("off", "/ck") is None
+    assert resolve_cache_dir(None, "/ck") is None
+    assert resolve_cache_dir("auto", None) is None
+    assert resolve_cache_dir("auto", "/ck") == "/ck/compile_cache"
+    assert resolve_cache_dir("/explicit", None) == "/explicit"
+    os.environ["DI_DISABLE_COMPILE_CACHE"] = "1"
+    try:
+        assert resolve_cache_dir("/explicit", "/ck") is None
+    finally:
+        del os.environ["DI_DISABLE_COMPILE_CACHE"]
+
+
+def test_compile_cache_enable(tmp_path):
+    from deepinteract_tpu.tuning.compile_cache import enable_compile_cache
+
+    msgs = []
+    cache_dir = str(tmp_path / "cc")
+    assert enable_compile_cache(cache_dir, log=msgs.append) is True
+    assert os.path.isdir(cache_dir)
+    assert jax.config.jax_compilation_cache_dir == cache_dir
+    assert any("compilation cache" in m for m in msgs)
+    assert enable_compile_cache(None, log=msgs.append) is False
+    # Leave the process-global config clean for other test modules.
+    jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_model_signature_excludes_tunables():
+    base = tiny_model_cfg()
+    tuned = consume.adopt_model_config(
+        base, consume.Adopted(
+            config=TrialConfig(remat=True, scan_chunks=False,
+                               pallas_fwd_blocks=2),
+            key="k", source="exact"))
+    assert model_signature(base) == model_signature(tuned)
+    wider = dataclasses.replace(
+        base, gnn=dataclasses.replace(base.gnn, hidden=32))
+    assert model_signature(base) != model_signature(wider)

@@ -1,0 +1,79 @@
+"""Assert a bench stdout capture ends in a parseable contract line.
+
+The driver parses the LAST line of its capture as the headline JSON
+record. Twice (BENCH_r01, BENCH_r05) a run finished with real numbers but
+landed ``"parsed": null`` because the last line was something else (the
+multi-hundred-KB stderr DETAIL dump, once; a stray log line, once). This
+tool makes that failure mode un-regressable: it validates that the final
+non-empty line of a capture parses as JSON and carries the contract keys
+bench.py promises. Wired as a fast-tier test
+(tests/test_bench_contract.py) against bench's own headline builder, and
+usable standalone against a real capture::
+
+    python tools/check_bench_contract.py bench_stdout.log
+    some-driver | tee log; python tools/check_bench_contract.py log
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline")
+
+
+def check_contract_text(text: str):
+    """Validate ``text``'s final non-empty line as the contract record.
+
+    Returns the parsed record dict; raises ValueError with a precise
+    reason otherwise (no line / not JSON / missing or mistyped keys)."""
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("capture is empty — no contract line to parse")
+    last = lines[-1].strip()
+    try:
+        record = json.loads(last)
+    except json.JSONDecodeError as exc:
+        raise ValueError(
+            f"final line is not JSON ({exc}); the driver would record "
+            f'"parsed": null. Line was: {last[:200]!r}')
+    if not isinstance(record, dict):
+        raise ValueError(f"final line parses to {type(record).__name__}, "
+                         "not an object")
+    missing = [k for k in REQUIRED_KEYS if k not in record]
+    if missing:
+        raise ValueError(f"contract record is missing keys {missing}; "
+                         f"got {sorted(record)}")
+    for key in ("value", "vs_baseline"):
+        if not isinstance(record[key], (int, float)):
+            raise ValueError(
+                f"contract key {key!r} must be a number, got "
+                f"{type(record[key]).__name__} ({record[key]!r})")
+    if not isinstance(record["metric"], str) or not record["metric"]:
+        raise ValueError("contract key 'metric' must be a non-empty string")
+    if "partial" in record and record["partial"] is not True:
+        raise ValueError("'partial' marker, when present, must be true "
+                         "(absent means the run was complete)")
+    return record
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] not in ("-",):
+        with open(argv[0]) as fh:
+            text = fh.read()
+    else:
+        text = sys.stdin.read()
+    try:
+        record = check_contract_text(text)
+    except ValueError as exc:
+        print(f"BENCH CONTRACT VIOLATION: {exc}", file=sys.stderr)
+        return 1
+    print(json.dumps({"contract_ok": True, "metric": record["metric"],
+                      "value": record["value"],
+                      "partial": bool(record.get("partial", False))}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
