@@ -93,6 +93,8 @@ SECTION_EST_S = {
     "ab_p128": 260,
     "ab_p256": 420,
     "tuned_ab": 320,
+    "stem_ab": 260,
+    "precision_ab": 300,
     "b1_p384_tiled": 420,
     "b1_p512_tiled": 480,
     "b1_p128_deeplab": 300,
@@ -282,8 +284,13 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
     pad = int(batch.graph1.node_feats.shape[1])
     afl = analytic_forward_flops(bs, pad)
     a_train = analytic_train_flops(afl, remat)
+    cfg = getattr(model, "cfg", None)
+    stem = getattr(cfg, "interaction_stem", "materialized") if cfg else None
+    dtype_name = (cfg.decoder.compute_dtype if cfg else None)
     entry = {
         "batch": bs, "pad": pad, "mode": mode,
+        "interaction_stem": stem,
+        "compute_dtype": dtype_name,
         "analytic_forward_flops": afl["forward_flops"],
         "analytic_train_flops": a_train,
         "decoder_flop_fraction": afl["decoder_fraction"],
@@ -356,6 +363,22 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
                 afl["forward_flops"] / ft["median"] / PEAK_FLOPS,
             "timing_protocol": ft,
         })
+        # Pair-tensor memory accounting: what the materialized [B, L, L,
+        # 2C] tensor would cost vs the compiled forward's actual temp
+        # (activation) bytes from memory_analysis() — the factorized
+        # stem's win, in the record where it can be watched.
+        mem = ft.get("memory")
+        if cfg is not None:
+            from deepinteract_tpu.models.stem import (
+                materialized_interaction_bytes,
+            )
+
+            dsize = 2 if cfg.decoder.compute_dtype == "bfloat16" else 4
+            ib = {"materialized_equiv_bytes": materialized_interaction_bytes(
+                bs, pad, pad, cfg.decoder.in_channels, dsize)}
+            if mem:
+                ib["forward_peak_temp_bytes"] = mem["temp_size_in_bytes"]
+            entry["interaction_bytes"] = ib
         if fxla:
             entry["xla_forward_flops"] = fxla
             entry["xla_forward_mfu"] = (fxla / ft["median"]) / PEAK_FLOPS
@@ -463,22 +486,30 @@ def _setup():
     _log(f"backend={dev.platform} device={dev.device_kind} "
          f"peak_flops={PEAK_FLOPS:.3e}")
 
-    # DI_BENCH_DTYPE=bfloat16 measures the bf16 decoder activation path
-    # (params/logits stay f32; see DecoderConfig.compute_dtype).
+    # DI_BENCH_DTYPE=bfloat16 measures the END-TO-END bf16 policy
+    # (models/policy.py: GT encoder + attention + decoder; params/norm
+    # stats/logits stay f32). DI_BENCH_STEM selects the interaction stem
+    # (default: the factorized production default — models/stem.py).
     bench_dtype = os.environ.get("DI_BENCH_DTYPE", "float32")
     if bench_dtype not in ("float32", "bfloat16"):
         raise SystemExit(
             f"DI_BENCH_DTYPE must be 'float32' or 'bfloat16', got {bench_dtype!r}"
         )
+    bench_stem = os.environ.get("DI_BENCH_STEM", "factorized")
+    if bench_stem not in ("factorized", "materialized"):
+        raise SystemExit(
+            f"DI_BENCH_STEM must be 'factorized' or 'materialized', "
+            f"got {bench_stem!r}")
 
-    def make_model(remat=False, attention_impl="auto", dtype=None):
+    def make_model(remat=False, attention_impl="auto", dtype=None,
+                   stem=None):
         base = ModelConfig()
         return DeepInteract(dataclasses.replace(
             base,
             gnn=dataclasses.replace(base.gnn, attention_impl=attention_impl),
-            decoder=dataclasses.replace(
-                base.decoder, compute_dtype=dtype or bench_dtype,
-                remat=remat),
+            decoder=dataclasses.replace(base.decoder, remat=remat),
+            compute_dtype=dtype or bench_dtype,
+            interaction_stem=stem or bench_stem,
         ))
 
     def make_extra(**overrides):
@@ -487,18 +518,21 @@ def _setup():
                 ModelConfig().gnn,
                 node_count_limit=overrides.pop("node_count_limit", 2304)),
             decoder=dataclasses.replace(
-                ModelConfig().decoder, compute_dtype=bench_dtype,
+                ModelConfig().decoder,
                 # Long-context tiles need remat like p256: the tile-scan
                 # backward's residuals (decoder activations x tile count)
                 # exceed HBM without it, and the un-remat graph crashes
                 # the remote compile helper outright.
                 remat=overrides.pop("remat", True)),
+            compute_dtype=bench_dtype,
+            interaction_stem=bench_stem,
         )
         return DeepInteract(dataclasses.replace(base, **overrides))
 
     return {
         "dev": dev,
         "bench_dtype": bench_dtype,
+        "bench_stem": bench_stem,
         "make_model": make_model,
         "make_extra": make_extra,
         "scan_k": int(os.environ.get("DI_BENCH_SCAN", "8")),
@@ -528,8 +562,8 @@ def _section_names(platform: str) -> list:
     # fell to the r5 decoder rewrite (measured: p384 train compiles 95 s,
     # runs 397 ms/step; p512 803 ms/step), so the >256-residue tier's
     # training now lands in the driver artifact, not only its forward.
-    names = ["b1_p128", "b8_p128_bf16", "b1_p256",
-             "b1_p384_tiled", "eval_path"]
+    names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
+             "b1_p256", "b1_p384_tiled", "eval_path"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -557,10 +591,6 @@ def _run_bucket_section(label: str, ctx, detail) -> None:
         bs, n1, n2, pad, remat, mode = EXTRA_SHAPES[label]
         extra = True
         if label == "b1_p128_deeplab":
-            if ctx["bench_dtype"] != "float32":
-                detail["buckets"][label] = {
-                    "skipped": "deeplab path is float32-only"}
-                return
             bench_model = ctx["make_extra"](interact_module_type="deeplab")
         elif label.startswith("b1_p384_tiled"):
             bench_model = ctx["make_extra"](tile_pair_map=True, tile_size=128,
@@ -823,14 +853,121 @@ def _run_tuned_ab_section(ctx, detail) -> None:
     _dump_partial(detail)
 
 
+def _run_stem_ab_section(ctx, detail) -> None:
+    """Factorized-vs-materialized interaction stem A/B at the headline
+    bucket: scanned train + forward through the shared differenced
+    protocol, same param values on both sides (one init, shared via
+    ``state.replace(apply_fn=...)`` — the two stems share one param
+    tree by construction, models/stem.py). Memory deltas come from
+    each side's compiled forward ``memory_analysis()``."""
+    import jax
+
+    from deepinteract_tpu.training.optim import OptimConfig
+    from deepinteract_tpu.training.steps import (
+        create_train_state,
+        multi_train_step,
+        stack_microbatches,
+    )
+
+    scan_k = ctx["scan_k"]
+    row = {"bucket": "b1_p128", "compute_dtype": ctx["bench_dtype"]}
+    detail["stem_ab"] = row
+    batch = _make_batch(1, 100, 80, 128)
+    base_model = ctx["make_model"](stem="factorized")
+    state = create_train_state(
+        base_model, batch,
+        optim_cfg=OptimConfig(steps_per_epoch=100, num_epochs=50),
+    )
+    for side in ("factorized", "materialized"):
+        model = ctx["make_model"](stem=side)
+        fwd = jax.jit(
+            lambda params, bstats, b, _m=model: _m.apply(
+                {"params": params, "batch_stats": bstats},
+                b.graph1, b.graph2, train=False,
+            )
+        )
+        _, ft, _ = _time_compiled(fwd, (state.params, state.batch_stats, batch))
+        entry = {"forward_ms": ft["median"] * 1e3}
+        if ft.get("memory"):
+            entry["forward_peak_temp_bytes"] = ft["memory"][
+                "temp_size_in_bytes"]
+        s_side = state.replace(apply_fn=model.apply)
+        stacked = stack_microbatches([batch] * scan_k)
+        mstep = jax.jit(lambda st, bst: multi_train_step(st, bst))
+        _, mt, _ = _time_compiled(mstep, (s_side, stacked),
+                                  iters=max(ITERS // 4, 3),
+                                  reps=min(REPS, 3))
+        entry["train_scan_ms_per_step"] = mt["median"] * 1e3 / scan_k
+        row[side] = entry
+        _dump_partial(detail)
+    f, m = row["factorized"], row["materialized"]
+    row["factorized_speedup_forward"] = m["forward_ms"] / f["forward_ms"]
+    row["factorized_speedup_train"] = (
+        m["train_scan_ms_per_step"] / f["train_scan_ms_per_step"])
+    if "forward_peak_temp_bytes" in f and "forward_peak_temp_bytes" in m:
+        row["factorized_temp_bytes_ratio"] = (
+            f["forward_peak_temp_bytes"] / max(m["forward_peak_temp_bytes"], 1))
+    _log(json.dumps({"stem_ab": row}))
+    _dump_partial(detail)
+
+
+def _run_precision_ab_section(ctx, detail) -> None:
+    """End-to-end f32-vs-bf16 dtype policy A/B at the b8 flagship
+    (scanned train, remat — the throughput regime where bandwidth
+    matters): both sides share param values (params are float32 under
+    either policy, models/policy.py), so this isolates the compute-dtype
+    effect."""
+    import jax
+
+    from deepinteract_tpu.training.optim import OptimConfig
+    from deepinteract_tpu.training.steps import (
+        create_train_state,
+        multi_train_step,
+        stack_microbatches,
+    )
+
+    scan_k = ctx["scan_k"]
+    row = {"bucket": "b8_p128_remat", "stem": ctx["bench_stem"]}
+    detail["precision_ab"] = row
+    batch = _make_batch(8, 100, 80, 128)
+    base_model = ctx["make_model"](remat=True, dtype="float32")
+    state = create_train_state(
+        base_model, jax.tree_util.tree_map(lambda x: x[:1], batch),
+        optim_cfg=OptimConfig(steps_per_epoch=100, num_epochs=50),
+    )
+    stacked = stack_microbatches([batch] * scan_k)
+    for dtype in ("float32", "bfloat16"):
+        model = ctx["make_model"](remat=True, dtype=dtype)
+        s_side = state.replace(apply_fn=model.apply)
+        mstep = jax.jit(lambda st, bst: multi_train_step(st, bst))
+        mc, mt, _ = _time_compiled(mstep, (s_side, stacked),
+                                   iters=max(ITERS // 4, 3),
+                                   reps=min(REPS, 3))
+        entry = {
+            "train_scan_ms_per_step": mt["median"] * 1e3 / scan_k,
+            "train_scan_complexes_per_sec": 8 * scan_k / mt["median"],
+            "compile_s": mc,
+        }
+        if mt.get("memory"):
+            entry["train_peak_temp_bytes"] = mt["memory"][
+                "temp_size_in_bytes"]
+        row[dtype] = entry
+        _dump_partial(detail)
+    row["bf16_speedup_train"] = (
+        row["float32"]["train_scan_ms_per_step"]
+        / row["bfloat16"]["train_scan_ms_per_step"])
+    _log(json.dumps({"precision_ab": row}))
+    _dump_partial(detail)
+
+
 def _section_result_key(name: str):
     """Where a section's result (or error) lives in the detail dict:
     (container, key). Buckets nest under 'buckets'; the A/B and eval
     sections use the same top-level keys their successes always used."""
     if name == "eval_path":
         return None, "eval_path_b128"
-    if name == "tuned_ab":
-        return None, "tuned_ab"
+    if name in ("tuned_ab", "stem_ab", "precision_ab"):
+        return None, name
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
     return "buckets", name
@@ -854,6 +991,10 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_eval_section(ctx, detail)
     elif name == "tuned_ab":
         _run_tuned_ab_section(ctx, detail)
+    elif name == "stem_ab":
+        _run_stem_ab_section(ctx, detail)
+    elif name == "precision_ab":
+        _run_precision_ab_section(ctx, detail)
     elif name.startswith("ab_p"):
         _run_ab_section(int(name[4:]), ctx, detail)
     else:
@@ -898,14 +1039,24 @@ def _build_headline(detail, scan_k) -> dict:
         return {
             "metric": f"train_complexes_per_sec_b1_p128_scan{scan_k}",
             "value": 0.0, "unit": "complexes/s", "vs_baseline": 0.0,
+            "interaction_stem": detail.get("interaction_stem"),
+            "compute_dtype": detail.get("compute_dtype"),
         }
     line = {
         "metric": metric,
         "value": round(value, 2),
         "unit": "complexes/s",
         "vs_baseline": round(value / CPU_BASELINE_COMPLEXES_PER_SEC, 2),
+        # Measurement provenance: which stem/precision produced the number
+        # (ISSUE-5 contract keys).
+        "interaction_stem": entry.get("interaction_stem",
+                                      detail.get("interaction_stem")),
+        "compute_dtype": entry.get("compute_dtype",
+                                   detail.get("compute_dtype")),
         **extra,
     }
+    if "interaction_bytes" in entry:
+        line["interaction_bytes"] = entry["interaction_bytes"]
     if "train_complexes_per_sec" in entry:
         line["train_step_complexes_per_sec_b1_p128"] = round(
             entry["train_complexes_per_sec"], 2)
@@ -926,7 +1077,8 @@ def _is_partial(detail) -> bool:
         return True
     candidates = list(detail.get("buckets", {}).values())
     candidates += [v for k, v in detail.items()
-                   if k.startswith(("attention_ab", "eval_path", "tuned_ab"))
+                   if k.startswith(("attention_ab", "eval_path", "tuned_ab",
+                                    "stem_ab", "precision_ab"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates
                if isinstance(c, dict))
@@ -1025,7 +1177,8 @@ def main() -> None:
     detail = {"backend": ctx["dev"].platform,
               "device_kind": ctx["dev"].device_kind,
               "iters": ITERS, "reps": REPS,
-              "compute_dtype": ctx["bench_dtype"], "buckets": {}}
+              "compute_dtype": ctx["bench_dtype"],
+              "interaction_stem": ctx["bench_stem"], "buckets": {}}
     scan_k = ctx["scan_k"]
 
     if section:

@@ -75,9 +75,26 @@ def add_model_args(p: argparse.ArgumentParser) -> None:
                         "TimmUniversalEncoder routing, "
                         "vision_modules.py:525-609)")
     g.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
-                   default="float32",
-                   help="decoder activation dtype; bfloat16 halves HBM "
-                        "traffic (params/norm stats/logits stay float32)")
+                   default=None,
+                   help="end-to-end activation/matmul dtype policy "
+                        "(models/policy.py): threads through the GT "
+                        "encoder, edge attention, and BOTH decoders "
+                        "(dilated and DeepLab). Params, norm statistics, "
+                        "softmax accumulators, logits and loss stay "
+                        "float32, so no loss scaling is needed. Default "
+                        "float32; an EXPLICIT setting is pinned against "
+                        "--autotune adoption")
+    g.add_argument("--interaction_stem", choices=("factorized", "materialized"),
+                   default=None,
+                   help="how the decoders consume the encoder output "
+                        "(models/stem.py): 'factorized' computes the "
+                        "first decoder layer from per-chain features "
+                        "without materializing the [L1, L2, 2C] "
+                        "interaction tensor (~256 MB f32/sample at the "
+                        "512 bucket); 'materialized' builds it (parity/"
+                        "A-B path — same params either way). Default "
+                        "factorized; an EXPLICIT setting is pinned "
+                        "against --autotune adoption")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize decoder blocks in backward (cuts "
                         "train-step HBM ~4x; required for batch 8 at "
@@ -180,6 +197,18 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
                         "logged) when a complex fails to load, instead of "
                         "killing the epoch; over budget still raises. "
                         "Single-host only (0 = fail fast)")
+
+    g = p.add_argument_group("input pipeline")
+    g.add_argument("--device_prefetch", action="store_true",
+                   help="move jax.device_put of upcoming batches onto the "
+                        "loader's prefetch thread (double-buffered h2d): "
+                        "the transfer overlaps the previous device_step "
+                        "instead of serializing before each dispatch. "
+                        "Single-device, per-step dispatch only — scanned "
+                        "multi-step dispatches stack batches on host "
+                        "(training/loop.py h2d caveat) and mesh runs "
+                        "place via shardings, so it is skipped (with a "
+                        "log line) there")
 
 
 def add_serving_args(p: argparse.ArgumentParser) -> None:
@@ -285,6 +314,11 @@ def configs_from_args(
         disable_geometric_mode=args.disable_geometric_mode,
         norm_type=args.norm_type,
     )
+    # None argparse defaults distinguish "operator typed the flag" from
+    # "left at default": autotune adoption must never override an explicit
+    # setting (see pinned_knobs / tuning.consume.respect_explicit).
+    compute_dtype = args.compute_dtype or "float32"
+    interaction_stem = getattr(args, "interaction_stem", None) or "factorized"
     decoder = DecoderConfig(
         num_chunks=args.num_interact_layers,
         num_channels=args.num_interact_hidden_channels,
@@ -292,17 +326,12 @@ def configs_from_args(
         dropout_rate=args.dropout_rate,
         remat=args.remat,
         remat_policy=args.remat_policy,
-        compute_dtype=args.compute_dtype,
+        compute_dtype=compute_dtype,
         scan_chunks=not args.unrolled_decoder,
         depad_stats=not args.no_depad_stats,
     )
     from deepinteract_tpu.models.vision import DeepLabConfig
 
-    if args.interact_module_type == "deeplab" and args.compute_dtype != "float32":
-        raise SystemExit(
-            "--compute_dtype bfloat16 is implemented for the dilated decoder "
-            "only; the DeepLabV3+ path runs float32"
-        )
     model_cfg = ModelConfig(
         gnn=gnn,
         decoder=decoder,
@@ -313,6 +342,11 @@ def configs_from_args(
         interact_module_type=args.interact_module_type,
         shard_pair_map=args.shard_pair_map or args.num_pair_shards > 1,
         tile_pair_map=args.tile_pair_map,
+        interaction_stem=interaction_stem,
+        # The model-level policy pushes the dtype into the GT encoder,
+        # dilated decoder AND DeepLab configs (models/policy.py) — the old
+        # DeepLab f32 hard-block is gone.
+        compute_dtype=compute_dtype,
     )
     optim_cfg = OptimConfig(
         lr=args.lr,
@@ -344,8 +378,20 @@ def configs_from_args(
         heartbeat_seconds=getattr(args, "heartbeat_seconds", 0.0),
         profile_dir=getattr(args, "profile_dir", None),
         profile_steps=getattr(args, "profile_steps", 3),
+        device_prefetch=getattr(args, "device_prefetch", False),
     )
     return model_cfg, optim_cfg, loop_cfg
+
+
+def pinned_knobs(args) -> dict:
+    """Which stem/precision knobs the operator set EXPLICITLY (argparse
+    sentinel defaults are None) — consumers pass this to
+    ``tuning.consume.respect_explicit`` so autotune adoption never
+    silently overrides a typed flag."""
+    return {
+        "stem": getattr(args, "interaction_stem", None) is not None,
+        "dtype": getattr(args, "compute_dtype", None) is not None,
+    }
 
 
 def make_mesh_from_args(args) -> Optional[object]:

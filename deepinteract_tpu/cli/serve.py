@@ -72,6 +72,9 @@ def main(argv=None) -> int:
             tuning_store = None
 
     model_cfg, _, _ = configs_from_args(args)
+    from deepinteract_tpu.cli.args import pinned_knobs
+
+    pins = pinned_knobs(args)
     engine_cfg = EngineConfig(
         max_batch=args.max_batch,
         max_delay_ms=args.max_delay_ms,
@@ -81,6 +84,10 @@ def main(argv=None) -> int:
         pad_to_max_bucket=args.pad_to_max_bucket,
         input_indep=args.input_indep,
         tuning_store=tuning_store,
+        # Explicitly typed --interaction_stem / --compute_dtype survive
+        # tuned-entry adoption (tuning/consume.respect_explicit).
+        pin_interaction_stem=pins["stem"],
+        pin_compute_dtype=pins["dtype"],
     )
     engine = InferenceEngine(
         model_cfg,

@@ -176,6 +176,13 @@ def main(argv=None) -> int:
                      for key in loader._buckets for p in key}
         adopted, blocks_note = consume.restrict_pallas_blocks(
             adopted, plan_pads, knn=C.KNN)
+        # Explicitly typed --interaction_stem / --compute_dtype are pinned:
+        # the adopted trial keeps its perf knobs but cannot override them.
+        from deepinteract_tpu.cli.args import pinned_knobs
+
+        pins = pinned_knobs(args)
+        adopted = consume.respect_explicit(
+            adopted, stem=pins["stem"], dtype=pins["dtype"])
         model_cfg = consume.adopt_model_config(model_cfg, adopted)
         if args.accumulate_grad_batches == 1:
             # Respect an explicit --accumulate_grad_batches: the tuned

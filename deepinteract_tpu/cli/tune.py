@@ -23,6 +23,7 @@ counters, and with ``--ckpt_dir`` the span log lands in
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import sys
@@ -116,6 +117,7 @@ def main(argv=None) -> int:
     from deepinteract_tpu.tuning.space import (
         axes_for_bucket,
         bucket_key,
+        default_trial,
         enumerate_trials,
         model_signature,
     )
@@ -147,7 +149,8 @@ def main(argv=None) -> int:
         bucket = bucket_key(batch, pad)
         axes = axes_for_bucket(
             batch, pad, device.device_kind,
-            include_loader_axis=args.dry_run or args.tune_loader_axes)
+            include_loader_axis=args.dry_run or args.tune_loader_axes,
+            base_stem=model_cfg.interaction_stem)
         trials = enumerate_trials(axes, max_trials=args.max_trials)
         if args.dry_run:
             measure = tmeasure.make_dry_run_measure(batch, pad)
@@ -169,6 +172,12 @@ def main(argv=None) -> int:
             trial_deadline_s=args.trial_deadline_s or None,
             total_budget_s=args.tune_budget_s or None,
             log=lambda m: print(m, flush=True),
+            # The grid names the stem concretely (axes_for_bucket), so
+            # the speedup-vs-default baseline is the default config WITH
+            # the configured stem spelled out.
+            baseline=dataclasses.replace(
+                default_trial(),
+                interaction_stem=model_cfg.interaction_stem),
         )
         result = search.run(trials)
         entry = store.get(key)

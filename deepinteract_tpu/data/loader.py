@@ -37,6 +37,9 @@ _BATCHES = obs_metrics.counter(
 _SKIPPED = obs_metrics.counter(
     "di_data_skipped_batches_total",
     "Batches dropped by the corrupt-complex skip budget")
+_DEVICE_PREFETCHED = obs_metrics.counter(
+    "di_data_device_prefetched_batches_total",
+    "Batches whose h2d transfer was issued on the loader's prefetch thread")
 
 
 def make_bucket_fn(pad_to_max_bucket: bool = False,
@@ -136,6 +139,16 @@ class BucketedLoader:
                 "training cannot skip batches on one host only)"
             )
         self.skip_budget = max(0, skip_budget)
+        # Optional h2d hook (--device_prefetch): a callable applied to each
+        # assembled batch ON THE PREFETCH THREAD (``_produce`` runs inside
+        # ``_prefetched``'s worker when prefetch > 0). The Trainer installs
+        # ``jax.device_put`` here so the async transfer overlaps the
+        # consumer's device_step — double-buffered h2d via the queue depth.
+        # The Trainer only installs it for single-device, per-step-dispatch
+        # runs: scanned multi-step dispatches must keep batches on host
+        # (they np.stack K batches into one placement — training/loop.py
+        # h2d caveat) and mesh runs place via shardings.
+        self.device_transfer = None
         self._bucket_fn = None  # built once on first _item_bucket call
         # Bucket planning reads every header once, up front.
         self._buckets = self._plan()
@@ -257,6 +270,12 @@ class BucketedLoader:
                 )
                 continue
             _BATCHES.inc()
+            if self.device_transfer is not None:
+                # jax.device_put is async: issuing it here starts the h2d
+                # copy on the transfer engine while the consumer is still
+                # busy with the previous dispatch.
+                batch = self.device_transfer(batch)
+                _DEVICE_PREFETCHED.inc()
             yield (batch, targets) if with_targets else batch
 
     def iter_epoch(self, epoch: int = 0, with_targets: bool = False) -> Iterator:

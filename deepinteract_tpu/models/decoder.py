@@ -17,11 +17,15 @@ p ~= 0.001 (reference :1224-1226).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+from deepinteract_tpu.models import policy
+from deepinteract_tpu.models.policy import FLOAT32, OUTPUT_DTYPE, STATS_DTYPE
+from deepinteract_tpu.models.stem import PairFactors, PairStem1x1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,7 +94,7 @@ class DecoderConfig:
 
     @property
     def dtype(self):
-        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
+        return policy.compute_dtype(self.compute_dtype)
 
 
 def _remat_transform(policy: str):
@@ -139,7 +143,7 @@ def masked_instance_norm(x: jnp.ndarray, mask: Optional[jnp.ndarray], scale, bia
     robust two-pass (x - mean)^2 variance (ADVICE r4 item 1).
     """
     in_dtype = x.dtype
-    f32 = jnp.float32
+    f32 = STATS_DTYPE
     if mask is None:
         n = x.shape[1] * x.shape[2]
         s1 = jnp.sum(x, axis=(1, 2), keepdims=True, dtype=f32)
@@ -184,7 +188,7 @@ def depadded_instance_norm(x, count, pad_value, scale, bias, eps=1e-6):
     Returns ``(y, pad_value_out)`` with ``pad_value_out`` [B, 1, 1, C] in
     x's dtype.
     """
-    f32 = jnp.float32
+    f32 = STATS_DTYPE
     in_dtype = x.dtype
     n_total = float(x.shape[1] * x.shape[2])
     s1 = jnp.sum(x, axis=(1, 2), keepdims=True, dtype=f32)
@@ -234,7 +238,7 @@ class PVConv1x1(nn.Module):
     tools/tiny_op_probe.py)."""
 
     features: int
-    dtype: jnp.dtype = jnp.float32
+    dtype: Any = FLOAT32
 
     @nn.compact
     def __call__(self, x, pv=None):
@@ -265,7 +269,7 @@ class SEBlock(nn.Module):
 
     channels: int
     ratio: int = 16
-    dtype: jnp.dtype = jnp.float32
+    dtype: Any = FLOAT32
 
     @nn.compact
     def __call__(self, x, mask=None, count=None, pad_value=None):
@@ -274,16 +278,16 @@ class SEBlock(nn.Module):
         # cost note.
         depad = count is not None and pad_value is not None
         if mask is None:
-            pooled = jnp.sum(x, axis=(1, 2), dtype=jnp.float32) / (
+            pooled = jnp.sum(x, axis=(1, 2), dtype=STATS_DTYPE) / (
                 x.shape[1] * x.shape[2])
         elif depad:
             n_pad = float(x.shape[1] * x.shape[2]) - count[:, 0, 0, :]
-            s = jnp.sum(x, axis=(1, 2), dtype=jnp.float32)
-            pooled = (s - n_pad * pad_value[:, 0, 0, :].astype(jnp.float32)
+            s = jnp.sum(x, axis=(1, 2), dtype=STATS_DTYPE)
+            pooled = (s - n_pad * pad_value[:, 0, 0, :].astype(STATS_DTYPE)
                       ) / count[:, 0, 0, :]
         else:
-            m = mask[..., None].astype(jnp.float32)
-            pooled = jnp.sum(x.astype(jnp.float32) * m, axis=(1, 2)) / (
+            m = mask[..., None].astype(STATS_DTYPE)
+            pooled = jnp.sum(x.astype(STATS_DTYPE) * m, axis=(1, 2)) / (
                 jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0))
         pooled = pooled.astype(self.dtype)
         h = nn.relu(nn.Dense(max(1, self.channels // self.ratio), dtype=self.dtype)(pooled))
@@ -324,7 +328,7 @@ class BottleneckBlock(nn.Module):
     channels: int
     dilation: int
     use_inorm: bool
-    dtype: jnp.dtype = jnp.float32
+    dtype: Any = FLOAT32
     depad: bool = False
     # True only under remat_policy='convs' (see _tag_conv).
     tag_convs: bool = False
@@ -416,7 +420,7 @@ class DilationChunk(nn.Module):
     dilation_cycle: Sequence[int]
     use_inorm: bool
     remat: bool = False
-    dtype: jnp.dtype = jnp.float32
+    dtype: Any = FLOAT32
     depad: bool = False
     remat_policy: str = "full"
 
@@ -454,7 +458,7 @@ class DilatedResNet(nn.Module):
     extra_blocks: bool = False
     remat: bool = False
     scan_chunks: bool = False
-    dtype: jnp.dtype = jnp.float32
+    dtype: Any = FLOAT32
     depad: bool = False
     remat_policy: str = "full"
 
@@ -527,7 +531,7 @@ class RegionalAttention(nn.Module):
     num_heads: int = 4
     region_size: int = 3
     dropout_rate: float = 0.1
-    dtype: jnp.dtype = jnp.float32
+    dtype: Any = FLOAT32
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -558,7 +562,7 @@ class RegionalAttention(nn.Module):
         qk = qk.reshape(b, hh, ww, s * s, n_head, dk_per_head).sum(-1)  # [B,H,W,s2,n_head]
         # Softmax in f32 (bf16 exponentials lose too much), back to compute dtype.
         att = nn.softmax(
-            qk.astype(jnp.float32) / jnp.sqrt(jnp.float32(self.d_k)), axis=3
+            qk.astype(STATS_DTYPE) / jnp.sqrt(STATS_DTYPE(self.d_k)), axis=3
         ).astype(qk.dtype)
         att = nn.Dropout(self.dropout_rate, deterministic=not train)(att)
         v_p = patches(v).reshape(b, hh, ww, s * s, n_head, self.channels // n_head)
@@ -571,24 +575,34 @@ class RegionalAttention(nn.Module):
 class InteractionDecoder(nn.Module):
     """Full decoder head: 1x1 conv + inorm -> base dilated ResNet (inorm) ->
     phase-2 ResNet (+extra blocks) -> 1x1 conv to classes
-    (ResNet2DInputWithOptAttention, deepinteract_modules.py:1155-1248)."""
+    (ResNet2DInputWithOptAttention, deepinteract_modules.py:1155-1248).
+
+    ``pair_tensor`` is either the materialized ``[B, L1, L2, 2C]``
+    interaction tensor or a :class:`~deepinteract_tpu.models.stem.
+    PairFactors` bundle — the factorized stem computes the entry 1x1 conv
+    from per-chain features without ever materializing the 2C tensor
+    (models/stem.py). Both paths share one param tree (``conv2d_1``)."""
 
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, pair_tensor: jnp.ndarray, mask=None, train: bool = False):
+    def __call__(self, pair_tensor, mask=None, train: bool = False):
         cfg = self.cfg
         dt = cfg.dtype
-        pair_tensor = pair_tensor.astype(dt)
+        if isinstance(pair_tensor, PairFactors) and mask is None:
+            mask = pair_tensor.pair_mask()
         # Valid-pixel count, computed ONCE and shared by every de-padded
         # statistic in the stack ([B, 1, 1, 1] float32).
         depad = mask is not None and cfg.depad_stats
         count = pv = None
         if depad:
             count = jnp.maximum(
-                jnp.sum(mask.astype(jnp.float32), axis=(1, 2),
+                jnp.sum(mask.astype(STATS_DTYPE), axis=(1, 2),
                         keepdims=True)[..., None], 1.0)
-        x = nn.Conv(cfg.num_channels, (1, 1), dtype=dt, name="conv2d_1")(pair_tensor)
+        # The entry conv: factorized (two per-chain matmuls + broadcast
+        # add, O(L*C^2), no 2C tensor) or materialized (the plain 1x1).
+        x = PairStem1x1(cfg.num_channels, dtype=dt,
+                        name="conv2d_1")(pair_tensor)
         if depad:
             # The ONE entry mask: the incoming pair tensor's padded pixels
             # are arbitrary (GT features of padded nodes), so zero them
@@ -638,13 +652,13 @@ class InteractionDecoder(nn.Module):
         # length-1 cycle would change its tree for no compile saving.
         # Positive-class bias -7 => initial positive probability ~0.001
         # (reference reset_parameters, deepinteract_modules.py:1219-1226).
-        def final_bias(key, shape, dtype=jnp.float32):
+        def final_bias(key, shape, dtype=OUTPUT_DTYPE):
             bias = jnp.zeros(shape, dtype)
             return bias.at[1].set(-7.0)
 
         # Logits in float32 regardless of the activation dtype.
         logits = nn.Conv(cfg.num_classes, (1, 1), bias_init=final_bias,
-                         name="phase2_conv")(x.astype(jnp.float32))
+                         name="phase2_conv")(x.astype(OUTPUT_DTYPE))
         if mask is not None:
             logits = logits * mask[..., None]
         return logits

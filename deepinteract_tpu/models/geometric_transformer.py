@@ -23,9 +23,12 @@ Design notes (TPU-first, not a port):
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax.numpy as jnp
 from flax import linen as nn
+
+from deepinteract_tpu.models import policy
 
 from deepinteract_tpu import constants as C
 from deepinteract_tpu.data.graph import ProteinGraph
@@ -80,6 +83,15 @@ class GTConfig:
     # the autotuner (tuning/space.py) and adopted from its store.
     pallas_fwd_blocks: "int | None" = None
     pallas_bwd_blocks: "int | None" = None
+    # Activation/matmul compute dtype for the whole encoder stack
+    # ('float32' | 'bfloat16') — one leg of the model-wide dtype policy
+    # (models/policy.py). Params, normalization statistics, and softmax
+    # accumulators stay float32; bf16 halves the edge-tensor HBM traffic.
+    compute_dtype: str = "float32"
+
+    @property
+    def dtype(self):
+        return policy.compute_dtype(self.compute_dtype)
 
 
 def _split_geo_feats(orig_edge_feats: jnp.ndarray):
@@ -110,6 +122,7 @@ class InitEdgeModule(nn.Module):
     def __call__(self, graph: ProteinGraph, orig_edge_feats: jnp.ndarray) -> jnp.ndarray:
         cfg = self.cfg
         ch = cfg.hidden
+        GODense_ = functools.partial(GODense, dtype=cfg.dtype)
         b, n, k = graph.nbr_idx.shape
 
         if n > cfg.node_count_limit:
@@ -119,7 +132,8 @@ class InitEdgeModule(nn.Module):
                 "long-context buckets (jnp.take would silently clamp indices)"
             )
         node_embedding = nn.Embed(
-            cfg.node_count_limit, ch, embedding_init=uniform_sqrt3(), name="node_embedding"
+            cfg.node_count_limit, ch, embedding_init=uniform_sqrt3(),
+            dtype=cfg.dtype, name="node_embedding"
         )
         node_ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (b, n))
         node_emb = node_embedding(node_ids)  # [B, N, C]
@@ -129,32 +143,32 @@ class InitEdgeModule(nn.Module):
         msgs = _edge_messages(orig_edge_feats)
         dist, direc, orient, amide = _split_geo_feats(orig_edge_feats)
 
-        msg0 = GODense(ch, use_bias=False, name="edge_messages_linear_0")(msgs)
-        dist0 = nn.silu(GODense(ch, use_bias=False, name="dist_linear_0")(dist))
-        dir0 = nn.silu(GODense(ch, use_bias=False, name="dir_linear_0")(direc))
-        orient0 = nn.silu(GODense(ch, use_bias=False, name="orient_linear_0")(orient))
-        amide0 = nn.silu(GODense(ch, use_bias=False, name="amide_linear_0")(amide))
+        msg0 = GODense_(ch, use_bias=False, name="edge_messages_linear_0")(msgs)
+        dist0 = nn.silu(GODense_(ch, use_bias=False, name="dist_linear_0")(dist))
+        dir0 = nn.silu(GODense_(ch, use_bias=False, name="dir_linear_0")(direc))
+        orient0 = nn.silu(GODense_(ch, use_bias=False, name="orient_linear_0")(orient))
+        amide0 = nn.silu(GODense_(ch, use_bias=False, name="amide_linear_0")(amide))
 
         combined = nn.silu(
-            GODense(ch, use_bias=False, name="combined_linear_0")(
+            GODense_(ch, use_bias=False, name="combined_linear_0")(
                 jnp.concatenate([src_emb, dst_emb, msg0, dist0, dir0, orient0, amide0], axis=-1)
             )
         )
 
         # Gated second branch; note the message branch is NOT activated
         # (reference edge_messages_1, deepinteract_modules.py:240-246).
-        msg1 = GODense(ch, use_bias=False, name="edge_messages_linear_1")(msgs) * combined
-        dist1 = nn.silu(GODense(ch, use_bias=False, name="dist_linear_1")(dist)) * combined
-        dir1 = nn.silu(GODense(ch, use_bias=False, name="dir_linear_1")(direc)) * combined
-        orient1 = nn.silu(GODense(ch, use_bias=False, name="orient_linear_1")(orient)) * combined
-        amide1 = nn.silu(GODense(ch, use_bias=False, name="amide_linear_1")(amide)) * combined
+        msg1 = GODense_(ch, use_bias=False, name="edge_messages_linear_1")(msgs) * combined
+        dist1 = nn.silu(GODense_(ch, use_bias=False, name="dist_linear_1")(dist)) * combined
+        dir1 = nn.silu(GODense_(ch, use_bias=False, name="dir_linear_1")(direc)) * combined
+        orient1 = nn.silu(GODense_(ch, use_bias=False, name="orient_linear_1")(orient)) * combined
+        amide1 = nn.silu(GODense_(ch, use_bias=False, name="amide_linear_1")(amide)) * combined
 
         combined_out = C.NUM_EDGE_MESSAGE_FEATS + C.NUM_DIST_FEATS + C.NUM_DIR_FEATS \
             + C.NUM_ORIENT_FEATS + C.NUM_AMIDE_FEATS
-        out = GODense(combined_out, use_bias=False, name="combined_linear_1")(
+        out = GODense_(combined_out, use_bias=False, name="combined_linear_1")(
             msg1 + dist1 + dir1 + orient1 + amide1
         )
-        return GODense(ch, use_bias=False, name="combined_linear_2")(out)
+        return GODense_(ch, use_bias=False, name="combined_linear_2")(out)
 
 
 class ConformationModule(nn.Module):
@@ -172,6 +186,7 @@ class ConformationModule(nn.Module):
     ) -> jnp.ndarray:
         cfg = self.cfg
         ch = cfg.hidden
+        GODense_ = functools.partial(GODense, dtype=cfg.dtype)
         b, n, k = graph.nbr_idx.shape
         edge_mask = graph.edge_mask()
 
@@ -185,41 +200,43 @@ class ConformationModule(nn.Module):
         dst_nbr = flat[batch_ix, graph.dst_nbr_eids]
         nbr = jnp.concatenate([src_nbr, dst_nbr], axis=3)  # [B,N,K,2G,C]
 
-        nbr = nn.silu(GODense(ch, name="nbr_linear")(nbr))
+        nbr = nn.silu(GODense_(ch, name="nbr_linear")(nbr))
         res_edge_feats = edge_feats
 
-        emb_dist = GODense(ch, use_bias=False, name="dist_linear_1")(
-            GODense(cfg.dist_embed, use_bias=False, name="dist_linear_0")(dist)
+        emb_dist = GODense_(ch, use_bias=False, name="dist_linear_1")(
+            GODense_(cfg.dist_embed, use_bias=False, name="dist_linear_0")(dist)
         )
         nbr = nbr * emb_dist[..., None, :]
-        nbr = nn.silu(GODense(cfg.shared_embed, use_bias=False, name="downward_proj")(nbr))
-        nbr = nbr * GODense(cfg.shared_embed, use_bias=False, name="dir_linear_1")(
-            GODense(cfg.dir_embed, use_bias=False, name="dir_linear_0")(direc)
+        nbr = nn.silu(GODense_(cfg.shared_embed, use_bias=False, name="downward_proj")(nbr))
+        nbr = nbr * GODense_(cfg.shared_embed, use_bias=False, name="dir_linear_1")(
+            GODense_(cfg.dir_embed, use_bias=False, name="dir_linear_0")(direc)
         )[..., None, :]
-        nbr = nbr * GODense(cfg.shared_embed, use_bias=False, name="orient_linear_1")(
-            GODense(cfg.orient_embed, use_bias=False, name="orient_linear_0")(orient)
+        nbr = nbr * GODense_(cfg.shared_embed, use_bias=False, name="orient_linear_1")(
+            GODense_(cfg.orient_embed, use_bias=False, name="orient_linear_0")(orient)
         )[..., None, :]
-        nbr = nbr * GODense(cfg.shared_embed, use_bias=False, name="amide_linear_1")(
-            GODense(cfg.amide_embed, use_bias=False, name="amide_linear_0")(amide)
+        nbr = nbr * GODense_(cfg.shared_embed, use_bias=False, name="amide_linear_1")(
+            GODense_(cfg.amide_embed, use_bias=False, name="amide_linear_0")(amide)
         )[..., None, :]
         nbr = jnp.sum(nbr, axis=3)  # aggregate the 2G neighborhood
-        nbr = nn.silu(GODense(ch, use_bias=False, name="upward_proj")(nbr))
+        nbr = nn.silu(GODense_(ch, use_bias=False, name="upward_proj")(nbr))
 
-        out = GODense(ch, name="orig_msg_linear")(res_edge_feats) + nbr
+        out = GODense_(ch, name="orig_msg_linear")(res_edge_feats) + nbr
 
         for i in range(cfg.num_pre_res_blocks):
-            out = ResBlock(ch, cfg.norm_type, name=f"pre_res_block_{i}")(out, edge_mask, train)
-        out = res_edge_feats + nn.silu(GODense(ch, name="res_connect_linear")(out))
+            out = ResBlock(ch, cfg.norm_type, dtype=cfg.dtype,
+                           name=f"pre_res_block_{i}")(out, edge_mask, train)
+        out = res_edge_feats + nn.silu(GODense_(ch, name="res_connect_linear")(out))
         for i in range(cfg.num_post_res_blocks):
-            out = ResBlock(ch, cfg.norm_type, name=f"post_res_block_{i}")(out, edge_mask, train)
+            out = ResBlock(ch, cfg.norm_type, dtype=cfg.dtype,
+                           name=f"post_res_block_{i}")(out, edge_mask, train)
 
         gated = (
-            GODense(ch, use_bias=False, name="final_dist_linear")(dist) * out
-            + GODense(ch, use_bias=False, name="final_dir_linear")(direc) * out
-            + GODense(ch, use_bias=False, name="final_orient_linear")(orient) * out
-            + GODense(ch, use_bias=False, name="final_amide_linear")(amide) * out
+            GODense_(ch, use_bias=False, name="final_dist_linear")(dist) * out
+            + GODense_(ch, use_bias=False, name="final_dir_linear")(direc) * out
+            + GODense_(ch, use_bias=False, name="final_orient_linear")(orient) * out
+            + GODense_(ch, use_bias=False, name="final_amide_linear")(amide) * out
         )
-        return res_edge_feats + nn.silu(GODense(ch, name="final_linear")(gated))
+        return res_edge_feats + nn.silu(GODense_(ch, name="final_linear")(gated))
 
 
 class PlainEdgeModule(nn.Module):
@@ -231,7 +248,8 @@ class PlainEdgeModule(nn.Module):
     @nn.compact
     def __call__(self, orig_edge_feats: jnp.ndarray) -> jnp.ndarray:
         x = jnp.concatenate([_edge_messages(orig_edge_feats), orig_edge_feats], axis=-1)
-        return GODense(self.cfg.hidden, use_bias=False, name="linear")(x)
+        return GODense(self.cfg.hidden, use_bias=False, dtype=self.cfg.dtype,
+                       name="linear")(x)
 
 
 def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask,
@@ -288,22 +306,27 @@ class MultiHeadGeometricAttention(nn.Module):
     def __call__(self, graph: ProteinGraph, node_feats, edge_feats,
                  train: bool = False):
         cfg = self.cfg
+        dt = cfg.dtype
         h, d = cfg.num_heads, cfg.hidden // cfg.num_heads
         b, n, k = graph.nbr_idx.shape
         # Bias only if a Linear changes sizes (it never does here) —
         # reference deepinteract_modules.py:617-623.
-        q = GODense(cfg.hidden, use_bias=False, name="Q")(node_feats).reshape(b, n, h, d)
-        kk = GODense(cfg.hidden, use_bias=False, name="K")(node_feats).reshape(b, n, h, d)
-        v = GODense(cfg.hidden, use_bias=False, name="V")(node_feats).reshape(b, n, h, d)
-        proj_e = GODense(cfg.hidden, use_bias=False, name="edge_feats_projection")(
+        q = GODense(cfg.hidden, use_bias=False, dtype=dt, name="Q")(node_feats).reshape(b, n, h, d)
+        kk = GODense(cfg.hidden, use_bias=False, dtype=dt, name="K")(node_feats).reshape(b, n, h, d)
+        v = GODense(cfg.hidden, use_bias=False, dtype=dt, name="V")(node_feats).reshape(b, n, h, d)
+        proj_e = GODense(cfg.hidden, use_bias=False, dtype=dt,
+                         name="edge_feats_projection")(
             edge_feats
         ).reshape(b, n, k, h, d)
 
         h_out, e_out = _dispatch_attention(
             cfg, q, kk, v, proj_e, graph.nbr_idx, graph.edge_mask(), train
         )
-        h_out = h_out.reshape(b, n, cfg.hidden)
-        e_out = e_out.reshape(b, n, k, cfg.hidden) if self.update_edge_feats else None
+        # Both impls may return float32 accumulators (the Pallas kernel
+        # always does); the policy keeps activations in the compute dtype.
+        h_out = h_out.astype(dt).reshape(b, n, cfg.hidden)
+        e_out = (e_out.astype(dt).reshape(b, n, k, cfg.hidden)
+                 if self.update_edge_feats else None)
         return h_out, e_out
 
 
@@ -329,32 +352,38 @@ class GeometricTransformerLayer(nn.Module):
                 graph, edge_feats, orig_edge_feats, train
             )
 
-        node_feats = FeatureNorm(cfg.norm_type, name="norm1_node")(node_feats, node_mask, train)
-        edge_feats = FeatureNorm(cfg.norm_type, name="norm1_edge")(edge_feats, edge_mask, train)
+        node_feats = FeatureNorm(cfg.norm_type, dtype=cfg.dtype,
+                                 name="norm1_node")(node_feats, node_mask, train)
+        edge_feats = FeatureNorm(cfg.norm_type, dtype=cfg.dtype,
+                                 name="norm1_edge")(edge_feats, edge_mask, train)
 
         node_attn, edge_attn = MultiHeadGeometricAttention(
             cfg, update_edge_feats=self.update_edge_feats, name="mha"
         )(graph, node_feats, edge_feats, train)
 
         drop = nn.Dropout(cfg.dropout_rate, deterministic=not train)
-        node_feats = GODense(cfg.hidden, name="O_node")(drop(node_attn))
+        node_feats = GODense(cfg.hidden, dtype=cfg.dtype, name="O_node")(drop(node_attn))
         if cfg.residual:
             node_feats = node_in1 + node_feats
         node_in2 = node_feats
-        node_feats = FeatureNorm(cfg.norm_type, name="norm2_node")(node_feats, node_mask, train)
-        node_feats = MLP(cfg.hidden, cfg.dropout_rate, name="node_mlp")(node_feats, train)
+        node_feats = FeatureNorm(cfg.norm_type, dtype=cfg.dtype,
+                                 name="norm2_node")(node_feats, node_mask, train)
+        node_feats = MLP(cfg.hidden, cfg.dropout_rate, dtype=cfg.dtype,
+                         name="node_mlp")(node_feats, train)
         if cfg.residual:
             node_feats = node_in2 + node_feats
 
         if not self.update_edge_feats:
             return node_feats, None
 
-        edge_feats = GODense(cfg.hidden, name="O_edge")(drop(edge_attn))
+        edge_feats = GODense(cfg.hidden, dtype=cfg.dtype, name="O_edge")(drop(edge_attn))
         if cfg.residual:
             edge_feats = edge_in1 + edge_feats
         edge_in2 = edge_feats
-        edge_feats = FeatureNorm(cfg.norm_type, name="norm2_edge")(edge_feats, edge_mask, train)
-        edge_feats = MLP(cfg.hidden, cfg.dropout_rate, name="edge_mlp")(edge_feats, train)
+        edge_feats = FeatureNorm(cfg.norm_type, dtype=cfg.dtype,
+                                 name="norm2_edge")(edge_feats, edge_mask, train)
+        edge_feats = MLP(cfg.hidden, cfg.dropout_rate, dtype=cfg.dtype,
+                         name="edge_mlp")(edge_feats, train)
         if cfg.residual:
             edge_feats = edge_in2 + edge_feats
         return node_feats, edge_feats
@@ -370,7 +399,10 @@ class GeometricTransformer(nn.Module):
     @nn.compact
     def __call__(self, graph: ProteinGraph, node_feats: jnp.ndarray, train: bool = False):
         cfg = self.cfg
-        orig_edge_feats = graph.edge_feats  # raw 28-d, reused by every layer
+        # Entry cast into the compute dtype (no-op under float32): the raw
+        # feature tensors arrive float32 from the loader.
+        node_feats = node_feats.astype(cfg.dtype)
+        orig_edge_feats = graph.edge_feats.astype(cfg.dtype)  # raw 28-d
 
         if cfg.disable_geometric_mode:
             edge_feats = PlainEdgeModule(cfg, name="init_edge_module")(orig_edge_feats)
@@ -387,5 +419,5 @@ class GeometricTransformer(nn.Module):
                 cfg, update_edge_feats=False, name="final_gt_layer"
             )(graph, node_feats, edge_feats, orig_edge_feats, train)
 
-        node_feats = node_feats * graph.node_mask[..., None]
+        node_feats = node_feats * graph.node_mask[..., None].astype(cfg.dtype)
         return node_feats, edge_feats

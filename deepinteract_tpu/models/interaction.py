@@ -10,6 +10,12 @@ broadcast along columns, channels [C:] chain-2 features broadcast along
 rows — so checkpoint import (training/import_torch.py) needs no channel
 permutation. Padding is inherent — inputs arrive already padded, and the
 pair mask (outer product of node masks) travels with the tensor.
+
+This is the MATERIALIZED form. The production default avoids building it
+at all: the factorized interaction stem (``models/stem.py``) exploits the
+``[f1_i | f2_j]`` structure to compute the decoders' first layer directly
+from the per-chain factors — this module remains the parity/A-B reference
+and the building block for code that genuinely needs the dense tensor.
 """
 
 from __future__ import annotations

@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from deepinteract_tpu.models.policy import FLOAT32, STATS_DTYPE
+
 
 def glorot_orthogonal(scale: float = 2.0) -> Callable:
     """Orthogonal init rescaled to Glorot variance (reference
@@ -25,7 +27,7 @@ def glorot_orthogonal(scale: float = 2.0) -> Callable:
     """
     import math
 
-    def init(key, shape, dtype=jnp.float32):
+    def init(key, shape, dtype=FLOAT32):
         if len(shape) < 2:
             raise ValueError("glorot_orthogonal requires >=2D shapes")
         rows = math.prod(shape[:-1])
@@ -56,7 +58,7 @@ def uniform_sqrt3() -> Callable:
     """U(-sqrt(3), sqrt(3)) — reference node-index embedding init
     (deepinteract_modules.py:183)."""
 
-    def init(key, shape, dtype=jnp.float32):
+    def init(key, shape, dtype=FLOAT32):
         s = jnp.sqrt(3.0)
         return jax.random.uniform(key, shape, dtype, minval=-s, maxval=s)
 
@@ -64,11 +66,15 @@ def uniform_sqrt3() -> Callable:
 
 
 class GODense(nn.Module):
-    """Dense layer with glorot_orthogonal kernel init and zero bias."""
+    """Dense layer with glorot_orthogonal kernel init and zero bias.
+
+    ``dtype`` is the flax compute dtype (params stay float32 — the dtype
+    policy's param_dtype); None keeps flax promotion, i.e. float32."""
 
     features: int
     use_bias: bool = True
     scale: float = 2.0
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -77,6 +83,7 @@ class GODense(nn.Module):
             use_bias=self.use_bias,
             kernel_init=glorot_orthogonal(self.scale),
             bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
         )(x)
 
 
@@ -104,23 +111,28 @@ class MaskedBatchNorm(nn.Module):
         scale = self.param("scale", nn.initializers.ones, (ch,))
         bias = self.param("bias", nn.initializers.zeros, (ch,))
 
+        # Statistics always accumulate in float32 (the policy's stats
+        # dtype): bf16 sums over thousands of nodes/edges lose mantissa.
+        # Under f32 inputs every cast below is the identity, so the f32
+        # path's numerics are unchanged.
+        xf = x.astype(STATS_DTYPE)
         if use_ra:
             mean, var = ra_mean.value, ra_var.value
         else:
-            m = jnp.broadcast_to(mask[..., None], x.shape).astype(x.dtype)
+            m = jnp.broadcast_to(mask[..., None], x.shape).astype(STATS_DTYPE)
             count = jnp.maximum(jnp.sum(m), 1.0)
             axes = tuple(range(x.ndim - 1))
-            mean = jnp.sum(x * m, axis=axes) / count
-            var = jnp.sum(m * (x - mean) ** 2, axis=axes) / count
+            mean = jnp.sum(xf * m, axis=axes) / count
+            var = jnp.sum(m * (xf - mean) ** 2, axis=axes) / count
             if not self.is_initializing():
                 ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
                 # torch tracks the unbiased variance in running stats
                 unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
                 ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
-        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
         # Zero padded slots (don't pass raw values through): downstream code
         # may read intermediate features without re-masking.
-        return jnp.where(mask[..., None], y, 0.0)
+        return jnp.where(mask[..., None], y, 0.0).astype(x.dtype)
 
 
 class FeatureNorm(nn.Module):
@@ -128,11 +140,14 @@ class FeatureNorm(nn.Module):
     deepinteract_modules.py:605-613)."""
 
     norm_type: str = "batch"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, mask, train: bool = False):
         if self.norm_type == "layer":
-            return nn.LayerNorm()(x)
+            # flax LayerNorm computes its statistics in float32 internally;
+            # dtype only sets the output/affine compute dtype.
+            return nn.LayerNorm(dtype=self.dtype)(x)
         return MaskedBatchNorm()(x, mask, use_running_average=not train)
 
 
@@ -144,13 +159,15 @@ class ResBlock(nn.Module):
 
     hidden: int
     norm_type: str = "batch"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, mask, train: bool = False):
-        shared_norm = FeatureNorm(self.norm_type, name="shared_norm")
+        shared_norm = FeatureNorm(self.norm_type, dtype=self.dtype,
+                                  name="shared_norm")
         h = x
         for i in range(3):
-            h = GODense(self.hidden, name=f"linear_{i}")(h)
+            h = GODense(self.hidden, dtype=self.dtype, name=f"linear_{i}")(h)
             h = shared_norm(h, mask, train=train)
             h = nn.silu(h)
         return x + h
@@ -162,10 +179,11 @@ class MLP(nn.Module):
 
     hidden: int
     dropout_rate: float = 0.1
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = GODense(self.hidden * 2, use_bias=False)(x)
+        h = GODense(self.hidden * 2, use_bias=False, dtype=self.dtype)(x)
         h = nn.silu(h)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
-        return GODense(self.hidden, use_bias=False)(h)
+        return GODense(self.hidden, use_bias=False, dtype=self.dtype)(h)

@@ -22,6 +22,8 @@ from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
 from deepinteract_tpu.models.geometric_transformer import GeometricTransformer, GTConfig
 from deepinteract_tpu.models.interaction import interaction_tensor, pair_mask
 from deepinteract_tpu.models.layers import GODense
+from deepinteract_tpu.models.policy import validate_compute_dtype
+from deepinteract_tpu.models.stem import PairFactors, validate_stem
 from deepinteract_tpu.models.vision import DeepLabConfig, DeepLabDecoder
 
 
@@ -51,8 +53,36 @@ class ModelConfig:
     tile_pair_map: bool = False
     tile_size: int = C.PAIR_MAP_TILE
     deeplab: DeepLabConfig = dataclasses.field(default_factory=DeepLabConfig)
+    # How the decoders consume the encoder output (models/stem.py):
+    # 'factorized' (default) computes the first decoder layer from
+    # per-chain features without materializing the [B, L1, L2, 2C]
+    # interaction tensor — ~256 MB of f32 activations per sample at the
+    # L=512 bucket; 'materialized' builds the full tensor (kept for
+    # parity testing / A-B benchmarking — both share one param tree).
+    interaction_stem: str = "factorized"
+    # End-to-end compute-dtype policy (models/policy.py). None keeps the
+    # sub-configs' own settings (heterogeneous precision is allowed for
+    # A/Bs); 'float32'/'bfloat16' is pushed into the encoder, decoder AND
+    # DeepLab configs — params, norm statistics, logits and loss stay
+    # float32 either way, so no loss scaling is needed on TPU.
+    compute_dtype: "str | None" = None
 
     def __post_init__(self):
+        validate_stem(self.interaction_stem)
+        if self.compute_dtype is not None:
+            validate_compute_dtype(self.compute_dtype)
+            if self.gnn.compute_dtype != self.compute_dtype:
+                object.__setattr__(
+                    self, "gnn", dataclasses.replace(
+                        self.gnn, compute_dtype=self.compute_dtype))
+            if self.decoder.compute_dtype != self.compute_dtype:
+                object.__setattr__(
+                    self, "decoder", dataclasses.replace(
+                        self.decoder, compute_dtype=self.compute_dtype))
+            if self.deeplab.compute_dtype != self.compute_dtype:
+                object.__setattr__(
+                    self, "deeplab", dataclasses.replace(
+                        self.deeplab, compute_dtype=self.compute_dtype))
         updates = {}
         if self.decoder.in_channels != 2 * self.gnn.hidden:
             updates["in_channels"] = 2 * self.gnn.hidden
@@ -129,7 +159,8 @@ class DeepInteract(nn.Module):
     def setup(self):
         gnn_cfg = self.cfg.gnn
         if self.cfg.num_node_input_feats != gnn_cfg.hidden:
-            self.node_in_embedding = GODense(gnn_cfg.hidden, use_bias=False)
+            self.node_in_embedding = GODense(gnn_cfg.hidden, use_bias=False,
+                                             dtype=gnn_cfg.dtype)
         else:
             self.node_in_embedding = None
         if self.cfg.gnn_layer_type == "gcn":
@@ -160,6 +191,7 @@ class DeepInteract(nn.Module):
         feats2, efeats2 = self.encode(graph2, train=train)
 
         l1, l2 = feats1.shape[-2], feats2.shape[-2]
+        factorized = self.cfg.interaction_stem == "factorized"
         if self.cfg.tile_pair_map and (
             l1 > self.cfg.tile_size or l2 > self.cfg.tile_size
         ):
@@ -170,21 +202,32 @@ class DeepInteract(nn.Module):
                 graph1.node_mask, graph2.node_mask,
                 tile=self.cfg.tile_size, train=train,
                 shard_pair_axis=self.cfg.shard_pair_map,
+                stem=self.cfg.interaction_stem,
             )
+        elif factorized:
+            # Factorized stem (models/stem.py): the decoder's first layer
+            # is computed from per-chain factors — the [B, L1, L2, 2C]
+            # interaction tensor is never materialized. The pair mask is
+            # built (and, under context parallelism, sharding-annotated)
+            # here; the stem annotates its own broadcast output.
+            pm = pair_mask(graph1.node_mask, graph2.node_mask)
+            if self.cfg.shard_pair_map:
+                from deepinteract_tpu.models.stem import shard_pair_rows
+
+                pm = shard_pair_rows(pm)
+            factors = PairFactors(
+                feats1, feats2, graph1.node_mask, graph2.node_mask,
+                shard_pair=self.cfg.shard_pair_map,
+            )
+            logits = self.decoder(factors, pm, train=train)
         else:
             pm = pair_mask(graph1.node_mask, graph2.node_mask)
             tensor = interaction_tensor(feats1, feats2)
             if self.cfg.shard_pair_map:
-                from jax.sharding import PartitionSpec as P
+                from deepinteract_tpu.models.stem import shard_pair_rows
 
-                from deepinteract_tpu.parallel.mesh import DATA_AXIS, PAIR_AXIS
-
-                # Leave the batch dim unconstrained (its data-axis sharding
-                # flows from the inputs; pinning it would break batch-1 init
-                # traces).
-                spec = P(None, PAIR_AXIS)
-                tensor = jax.lax.with_sharding_constraint(tensor, spec)
-                pm = jax.lax.with_sharding_constraint(pm, spec)
+                tensor = shard_pair_rows(tensor)
+                pm = shard_pair_rows(pm)
             logits = self.decoder(tensor, pm, train=train)
 
         if return_representations:

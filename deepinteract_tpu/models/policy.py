@@ -1,0 +1,100 @@
+"""The model-wide dtype policy: the ONE place models/ names a dtype.
+
+End-to-end reduced precision on TPU is a *policy*, not a per-layer flag:
+params live in ``param_dtype`` (float32 — optimizer state and checkpoints
+never change layout), matmuls/convs/elementwise activations run in
+``compute_dtype`` (float32 or bfloat16), and outward-facing tensors
+(logits, loss, anything a metric reads) are ``output_dtype`` (float32).
+Normalization statistics, softmax accumulators, and other
+cancellation-sensitive reductions always accumulate in ``STATS_DTYPE``
+(float32) regardless of the compute dtype — that is what makes bf16 safe
+without loss scaling on TPU (bf16 shares float32's exponent range, so
+only reductions lose precision, and those are pinned here).
+
+Discipline: ``tools/check_dtype_discipline.py`` (a fast-tier AST lint)
+forbids hardcoded ``jnp.float32`` / ``jnp.bfloat16`` references anywhere
+in ``models/`` outside this module. Model code imports ``STATS_DTYPE`` /
+``OUTPUT_DTYPE`` or resolves a :class:`DTypePolicy` instead, so "where
+may precision change" has exactly one answer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+# float32 anchors. STATS_DTYPE is for accumulation-sensitive reductions
+# (norm moments, softmax exp/sums, pooled means); OUTPUT_DTYPE is for
+# outward-facing tensors (logits, probabilities, loss inputs). They are
+# the same dtype today but name different *reasons* — a future fp64
+# debugging policy would split them.
+STATS_DTYPE = jnp.float32
+OUTPUT_DTYPE = jnp.float32
+PARAM_DTYPE = jnp.float32
+# The canonical float32 for default module dtypes / initializer
+# signatures in models/ (the lint forbids naming jnp.float32 there).
+FLOAT32 = jnp.float32
+
+_COMPUTE_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+}
+
+
+def compute_dtype(name: str):
+    """'float32' | 'bfloat16' -> jnp dtype (the activation/matmul dtype)."""
+    try:
+        return _COMPUTE_DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown compute dtype {name!r}; expected one of "
+            f"{sorted(_COMPUTE_DTYPES)}") from None
+
+
+def validate_compute_dtype(name: str) -> str:
+    """Raise early (config construction time) on an unknown dtype name."""
+    compute_dtype(name)
+    return name
+
+
+@dataclasses.dataclass(frozen=True)
+class DTypePolicy:
+    """Resolved three-dtype policy threaded through the model stack.
+
+    ``compute`` is the only axis that varies today; ``param`` and
+    ``output`` are pinned float32 (no loss scaling needed on TPU — bf16
+    keeps float32's exponent range, and every reduction that could lose
+    mantissa accumulates in :data:`STATS_DTYPE`)."""
+
+    compute_name: str = "float32"
+
+    @property
+    def compute(self):
+        return compute_dtype(self.compute_name)
+
+    @property
+    def param(self):
+        return PARAM_DTYPE
+
+    @property
+    def output(self):
+        return OUTPUT_DTYPE
+
+    @property
+    def stats(self):
+        return STATS_DTYPE
+
+    def cast_compute(self, x):
+        """Cast an activation into the compute dtype (no-op under f32)."""
+        return x.astype(self.compute)
+
+    def cast_output(self, x):
+        """Cast an outward-facing tensor (logits) to the output dtype."""
+        return x.astype(self.output)
+
+
+def policy_for(compute_name: str = "float32") -> DTypePolicy:
+    """The policy for a config-level compute-dtype string."""
+    validate_compute_dtype(compute_name)
+    return DTypePolicy(compute_name=compute_name)

@@ -1,0 +1,279 @@
+"""Factorized interaction stem: the decoders' first layer without the 2C
+pair tensor.
+
+The interaction tensor (``models/interaction.py``) has algebraic
+structure: its value at ``(i, j)`` is the concatenation ``[f1_i | f2_j]``
+— constant along columns in its first C channels and along rows in the
+rest. Any *linear* map over it therefore splits exactly into a per-chain
+part: for the dilated decoder's 1x1 entry conv,
+
+    conv1x1([f1_i | f2_j]) = W1 @ f1_i + W2 @ f2_j + b,
+
+so the first decoder layer is two O(L*C^2) per-chain matmuls plus a
+broadcast add that materializes only ``num_channels`` (128) channels —
+never the ``2C`` (256) input tensor. For DeepLab's 7x7/2 stem conv the
+same split holds per channel block, and because the masked input is
+separable (``x[i,j] = g1_i * m2_j  (+)  g2_j * m1_i`` with
+``g = f * m``), each block reduces to a 1-D conv over its chain plus a
+rank-K combine against shifted mask slices — exact up to float
+association, including the zero-padding boundary (see
+:func:`factorized_stem_conv`).
+
+At the L=512 bucket the materialized float32 tensor is ~256 MB of
+activations per sample before the first conv runs; the factorized stem
+replaces it with the first layer's own output (half the channels, or a
+quarter of the bytes under bf16) — verified by the fast-tier
+``memory_analysis()`` regression test (tests/test_stem.py).
+
+Both decoders accept either form: a materialized ``[B, L1, L2, 2C]``
+tensor (kept for parity testing and checkpoint-import equivalence) or a
+:class:`PairFactors` bundle of per-chain features/masks. The parameter
+trees are IDENTICAL between the two paths — ``PairStem1x1`` declares the
+same ``kernel``/``bias`` leaves as the ``nn.Conv`` it replaces, and the
+DeepLab stem keeps its ``ConvNormAct_0/Conv_0`` naming — so checkpoints
+(including torch imports, training/import_torch.py) are interchangeable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deepinteract_tpu.models.policy import FLOAT32
+
+STEM_CHOICES = ("factorized", "materialized")
+
+
+def validate_stem(name: str) -> str:
+    if name not in STEM_CHOICES:
+        raise ValueError(
+            f"unknown interaction stem {name!r}; expected one of "
+            f"{STEM_CHOICES}")
+    return name
+
+
+class PairFactors:
+    """Per-chain factors of the interaction tensor: what the factorized
+    stem consumes instead of the materialized ``[B, L1, L2, 2C]`` map.
+
+    ``feats1``/``feats2`` are the encoded ``[B, L1, C]``/``[B, L2, C]``
+    chain features, ``mask1``/``mask2`` the ``[B, L]`` validity masks
+    (None = fully valid). ``shard_pair`` asks the stem to annotate its
+    broadcast output for the mesh's 'pair' axis — the factorized
+    equivalent of the sharding constraint the model used to place on the
+    materialized tensor. Registered as a pytree (masks/features are
+    children, ``shard_pair`` static) so factors cross jit/scan boundaries.
+    """
+
+    def __init__(self, feats1, feats2, mask1=None, mask2=None,
+                 shard_pair: bool = False):
+        self.feats1 = feats1
+        self.feats2 = feats2
+        self.mask1 = mask1
+        self.mask2 = mask2
+        self.shard_pair = bool(shard_pair)
+
+    def pair_mask(self):
+        """[B, L1, L2] validity mask, or None when both chains are fully
+        valid."""
+        if self.mask1 is None or self.mask2 is None:
+            return None
+        return self.mask1[:, :, None] & self.mask2[:, None, :]
+
+    def tree_flatten(self):
+        return ((self.feats1, self.feats2, self.mask1, self.mask2),
+                self.shard_pair)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, shard_pair=aux)
+
+
+jax.tree_util.register_pytree_node(
+    PairFactors,
+    lambda pf: pf.tree_flatten(),
+    PairFactors.tree_unflatten,
+)
+
+
+def shard_pair_rows(x):
+    """with_sharding_constraint over the mesh's 'pair' axis on the row
+    dim of a [B, L1, ...] pair-map tensor (requires an active mesh). The
+    ONE place the pair-axis PartitionSpec is spelled out — model.py and
+    tiled.py annotate through this helper too. The batch dim stays
+    unconstrained (its data-axis sharding flows from the inputs; pinning
+    it would break batch-1 init traces)."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepinteract_tpu.parallel.mesh import PAIR_AXIS
+
+    return jax.lax.with_sharding_constraint(x, P(None, PAIR_AXIS))
+
+
+class PairStem1x1(nn.Module):
+    """The dilated decoder's entry 1x1 conv, computable from factors.
+
+    Param tree is byte-identical to ``nn.Conv(features, (1, 1))`` (kernel
+    ``[1, 1, 2C, F]`` lecun-normal + bias ``[F]`` zeros) so checkpoints —
+    including torch imports mapping ``conv2d_1`` — load into either
+    stem. Materialized inputs take the real conv; ``PairFactors`` split
+    the kernel into its chain-1/chain-2 halves and materialize only the
+    ``features``-channel output:
+
+        out[b, i, j] = f1[b, i] @ K[:C] + f2[b, j] @ K[C:] + bias
+    """
+
+    features: int
+    dtype: Any = FLOAT32
+
+    @nn.compact
+    def __call__(self, x):
+        factored = isinstance(x, PairFactors)
+        if factored:
+            in_ch = x.feats1.shape[-1] + x.feats2.shape[-1]
+        else:
+            in_ch = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (1, 1, in_ch, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        k = kernel.astype(self.dtype)
+        b = bias.astype(self.dtype)
+        if not factored:
+            return jax.lax.conv_general_dilated(
+                x.astype(self.dtype), k, (1, 1), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+        c1 = x.feats1.shape[-1]
+        r1 = x.feats1.astype(self.dtype) @ k[0, 0, :c1]    # [B, L1, F]
+        r2 = x.feats2.astype(self.dtype) @ k[0, 0, c1:] + b  # [B, L2, F]
+        out = r1[:, :, None, :] + r2[:, None, :, :]
+        if x.shard_pair:
+            out = shard_pair_rows(out)
+        return out
+
+
+def _same_pad(size: int, kernel: int, stride: int):
+    """Flax/XLA 'SAME' padding (lo, hi) for one spatial dim."""
+    out = -(-size // stride)
+    total = max((out - 1) * stride + kernel - size, 0)
+    lo = total // 2
+    return lo, total - lo, out
+
+
+def _conv1d(x, kernel, stride: int, pad):
+    """[B, L, Cin] x [K, Cin, Cout] -> [B, Lout, Cout]."""
+    return jax.lax.conv_general_dilated(
+        x, kernel, (stride,), (pad,),
+        dimension_numbers=("NHC", "HIO", "NHC"))
+
+
+def _shifted_mask(mask, kernel: int, stride: int, pad, out: int):
+    """[B, L] 0-padded mask -> [B, Lout, K] with entry (o, t) =
+    mask[stride*o + t - lo] (zero outside) — the per-tap mask slices the
+    factorized combine contracts against."""
+    lo, hi = pad
+    mp = jnp.pad(mask, ((0, 0), (lo, hi)))
+    cols = [mp[:, t : t + stride * (out - 1) + 1 : stride]
+            for t in range(kernel)]
+    return jnp.stack(cols, axis=-1)
+
+
+def factorized_stem_conv(factors: PairFactors, kernel, stride: int,
+                         dtype=None):
+    """A KxK/stride 'SAME' conv of the *masked* materialized pair tensor,
+    computed from per-chain factors without materializing it.
+
+    ``kernel``: [K, K, C1+C2, F] (no bias — DeepLab's stem conv is
+    bias-free). The masked tensor is channel-block separable,
+    ``x[:, i, j, :C1] = g1[i] * m2[j]`` and
+    ``x[:, i, j, C1:] = g2[j] * m1[i]`` with ``g = f * m``, so each
+    block's conv is a 1-D conv over its own chain (taps x input channels
+    folded into ``K * F`` output channels) contracted against the other
+    chain's shifted-mask slices:
+
+        y1[b,oi,oj,f] = sum_t A1[b,oi,t,f] * M2[b,oj,t]
+        A1 = conv1d(g1, K1),  M2[b,oj,t] = m2_padded[b, stride*oj + t]
+
+    (symmetrically for the second block) — exact vs the 2-D conv up to
+    float association, including the zero-padded boundary, because zero
+    padding extends masks and features by zeros consistently.
+
+    Returns [B, Hout, Wout, F].
+    """
+    kh, kw, _, f = kernel.shape
+    f1, f2 = factors.feats1, factors.feats2
+    c1 = f1.shape[-1]
+    dt = dtype or f1.dtype
+    h, w = f1.shape[1], f2.shape[1]
+    lo_h, hi_h, out_h = _same_pad(h, kh, stride)
+    lo_w, hi_w, out_w = _same_pad(w, kw, stride)
+
+    m1, m2 = factors.mask1, factors.mask2
+    m1f = jnp.ones((f1.shape[0], h), dt) if m1 is None else m1.astype(dt)
+    m2f = jnp.ones((f2.shape[0], w), dt) if m2 is None else m2.astype(dt)
+    g1 = f1.astype(dt) * m1f[..., None]
+    g2 = f2.astype(dt) * m2f[..., None]
+    k = kernel.astype(dt)
+
+    # Chain-1 block: conv over rows with output channels (col-tap, F).
+    k1 = k[:, :, :c1, :].transpose(0, 2, 1, 3).reshape(kh, c1, kw * f)
+    a1 = _conv1d(g1, k1, stride, (lo_h, hi_h)).reshape(-1, out_h, kw, f)
+    m2s = _shifted_mask(m2f, kw, stride, (lo_w, hi_w), out_w)
+    y = jnp.einsum("bitf,bjt->bijf", a1, m2s)
+
+    # Chain-2 block: conv over columns with output channels (row-tap, F).
+    c2 = k.shape[2] - c1
+    k2 = k[:, :, c1:, :].transpose(1, 2, 0, 3).reshape(kw, c2, kh * f)
+    a2 = _conv1d(g2, k2, stride, (lo_w, hi_w)).reshape(-1, out_w, kh, f)
+    m1s = _shifted_mask(m1f, kh, stride, (lo_h, hi_h), out_h)
+    y = y + jnp.einsum("bjtf,bit->bijf", a2, m1s)
+    if factors.shard_pair:
+        y = shard_pair_rows(y)
+    return y
+
+
+class DeepLabStemConv(nn.Module):
+    """DeepLab's 7x7/2 bias-free stem conv, computable from factors.
+
+    Declares the exact ``kernel`` leaf ``nn.Conv(features, (7, 7),
+    use_bias=False)`` would — instantiated under the encoder's historical
+    ``ConvNormAct_0/Conv_0`` scope so the DeepLab param tree is unchanged
+    and both stem modes share checkpoints."""
+
+    features: int
+    kernel_size: int = 7
+    stride: int = 2
+    dtype: Any = FLOAT32
+
+    @nn.compact
+    def __call__(self, x):
+        ks = self.kernel_size
+        factored = isinstance(x, PairFactors)
+        in_ch = (x.feats1.shape[-1] + x.feats2.shape[-1]
+                 if factored else x.shape[-1])
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (ks, ks, in_ch, self.features))
+        if factored:
+            return factorized_stem_conv(x, kernel, self.stride,
+                                        dtype=self.dtype)
+        h, w = x.shape[1], x.shape[2]
+        lo_h, hi_h, _ = _same_pad(h, ks, self.stride)
+        lo_w, hi_w, _ = _same_pad(w, ks, self.stride)
+        return jax.lax.conv_general_dilated(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            (self.stride, self.stride),
+            ((lo_h, hi_h), (lo_w, hi_w)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def materialized_interaction_bytes(batch: int, l1: int, l2: int,
+                                   channels_2c: int,
+                                   dtype_bytes: int = 4) -> int:
+    """Bytes the materialized ``[B, L1, L2, 2C]`` tensor would occupy —
+    the bench's 'materialized-equivalent' reference for
+    ``interaction_bytes`` bucket records."""
+    return batch * l1 * l2 * channels_2c * dtype_bytes

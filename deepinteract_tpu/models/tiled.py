@@ -47,6 +47,7 @@ def tiled_decode(
     tile: int,
     train: bool = False,
     shard_pair_axis: bool = False,
+    stem: str = "materialized",
 ) -> jnp.ndarray:
     """Decode the [B, L1, L2] pair map in T x T tiles.
 
@@ -60,6 +61,11 @@ def tiled_decode(
         mesh, like ModelConfig.shard_pair_map's untiled path). The tile
         grid stays a sequential scan; each tile's convs shard across
         devices with XLA inserting the halo exchanges.
+      stem: 'factorized' hands the decoder per-tile ``PairFactors`` so
+        even the tile's own [T, T, 2C] tensor is never materialized (only
+        the first layer's [T, T, num_channels] output is);
+        'materialized' builds the tile tensor as before. Same params
+        either way (models/stem.py).
 
     Returns [B, L1, L2, num_classes] logits (padded region zeroed).
     """
@@ -73,22 +79,27 @@ def tiled_decode(
         f2 = lax.dynamic_slice_in_dim(feats2, tj * tile, tile, axis=1)
         m1 = lax.dynamic_slice_in_dim(mask1, ti * tile, tile, axis=1)
         m2 = lax.dynamic_slice_in_dim(mask2, tj * tile, tile, axis=1)
-        pair = jnp.concatenate(
-            [
-                jnp.broadcast_to(f1[:, :, None, :], (b, tile, tile, c)),
-                jnp.broadcast_to(f2[:, None, :, :], (b, tile, tile, c)),
-            ],
-            axis=-1,
-        )
         pm = m1[:, :, None] & m2[:, None, :]
         if shard_pair_axis:
-            import jax
-            from jax.sharding import PartitionSpec as P
+            from deepinteract_tpu.models.stem import shard_pair_rows
 
-            from deepinteract_tpu.parallel.mesh import PAIR_AXIS
+            pm = shard_pair_rows(pm)
+        if stem == "factorized":
+            from deepinteract_tpu.models.stem import PairFactors
 
-            pair = jax.lax.with_sharding_constraint(pair, P(None, PAIR_AXIS))
-            pm = jax.lax.with_sharding_constraint(pm, P(None, PAIR_AXIS))
+            pair = PairFactors(f1, f2, m1, m2, shard_pair=shard_pair_axis)
+        else:
+            pair = jnp.concatenate(
+                [
+                    jnp.broadcast_to(f1[:, :, None, :], (b, tile, tile, c)),
+                    jnp.broadcast_to(f2[:, None, :, :], (b, tile, tile, c)),
+                ],
+                axis=-1,
+            )
+            if shard_pair_axis:
+                from deepinteract_tpu.models.stem import shard_pair_rows
+
+                pair = shard_pair_rows(pair)
         logits = dec(pair, pm, train=train)
         return carry, logits
 

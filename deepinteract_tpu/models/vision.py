@@ -32,13 +32,16 @@ The final positive-class bias starts at -7 like the dilated decoder
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from deepinteract_tpu.models import policy
 from deepinteract_tpu.models.decoder import InstanceNorm
+from deepinteract_tpu.models.policy import FLOAT32, OUTPUT_DTYPE, STATS_DTYPE
+from deepinteract_tpu.models.stem import DeepLabStemConv, PairFactors
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,6 +78,14 @@ class DeepLabConfig:
     # Rematerialize encoder blocks in backward (same flag/semantics as
     # DecoderConfig.remat; nn.remat preserves the param tree).
     remat: bool = False
+    # Activation/conv compute dtype ('float32' | 'bfloat16') — the DeepLab
+    # leg of the model-wide dtype policy (models/policy.py). Params and
+    # instance-norm statistics stay float32; logits are float32.
+    compute_dtype: str = "float32"
+
+    @property
+    def dtype(self):
+        return policy.compute_dtype(self.compute_dtype)
 
     def __post_init__(self):
         if self.output_stride not in (8, 16):
@@ -109,6 +120,7 @@ class ConvNormAct(nn.Module):
     stride: int = 1
     dilation: int = 1
     use_act: bool = True
+    dtype: Any = FLOAT32
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -116,7 +128,7 @@ class ConvNormAct(nn.Module):
             self.features, (self.kernel, self.kernel),
             strides=(self.stride, self.stride),
             kernel_dilation=(self.dilation, self.dilation),
-            padding="SAME", use_bias=False,
+            padding="SAME", use_bias=False, dtype=self.dtype,
         )(x)
         x = InstanceNorm(self.features)(x, mask)
         return nn.relu(x) if self.use_act else x
@@ -128,6 +140,7 @@ class SeparableConv(nn.Module):
 
     features: int
     dilation: int = 1
+    dtype: Any = FLOAT32
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -135,9 +148,9 @@ class SeparableConv(nn.Module):
         x = nn.Conv(
             c_in, (3, 3), feature_group_count=c_in,
             kernel_dilation=(self.dilation, self.dilation),
-            padding="SAME", use_bias=False,
+            padding="SAME", use_bias=False, dtype=self.dtype,
         )(x)
-        x = nn.Conv(self.features, (1, 1), use_bias=False)(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
         x = InstanceNorm(self.features)(x, mask)
         return nn.relu(x)
 
@@ -155,18 +168,23 @@ class BasicBlock(nn.Module):
     stride: int = 1
     dilation: int = 1
     use_projection: Optional[bool] = None
+    dtype: Any = FLOAT32
 
     @nn.compact
     def __call__(self, x, mask=None):
         identity = x
-        y = ConvNormAct(self.features, 3, self.stride, self.dilation)(x, mask)
-        y = ConvNormAct(self.features, 3, 1, self.dilation, use_act=False)(y, mask)
+        dt = self.dtype
+        y = ConvNormAct(self.features, 3, self.stride, self.dilation,
+                        dtype=dt)(x, mask)
+        y = ConvNormAct(self.features, 3, 1, self.dilation, use_act=False,
+                        dtype=dt)(y, mask)
         project = (
             self.use_projection if self.use_projection is not None
             else self.stride != 1 or x.shape[-1] != self.features
         )
         if project:
-            identity = ConvNormAct(self.features, 1, self.stride, use_act=False)(x, mask)
+            identity = ConvNormAct(self.features, 1, self.stride,
+                                   use_act=False, dtype=dt)(x, mask)
         return nn.relu(y + identity)
 
 
@@ -179,10 +197,12 @@ class BottleneckResBlock(nn.Module):
     stride: int = 1
     dilation: int = 1
     use_projection: Optional[bool] = None
+    dtype: Any = FLOAT32
 
     @nn.compact
     def __call__(self, x, mask=None):
         identity = x
+        dt = self.dtype
         mid = self.features // 4
         # Stride on the first 1x1 (ResNet v1 convention): the downsampled
         # mask the encoder passes then matches every norm in the block
@@ -197,15 +217,16 @@ class BottleneckResBlock(nn.Module):
         # mapping, so a v1.5 import cannot happen silently; anyone adding
         # one must re-layout the stride onto the 3x3 (and rescale the
         # masks) first. Our from-scratch resnet50 trains under v1.
-        y = ConvNormAct(mid, 1, self.stride)(x, mask)
-        y = ConvNormAct(mid, 3, 1, self.dilation)(y, mask)
-        y = ConvNormAct(self.features, 1, use_act=False)(y, mask)
+        y = ConvNormAct(mid, 1, self.stride, dtype=dt)(x, mask)
+        y = ConvNormAct(mid, 3, 1, self.dilation, dtype=dt)(y, mask)
+        y = ConvNormAct(self.features, 1, use_act=False, dtype=dt)(y, mask)
         project = (
             self.use_projection if self.use_projection is not None
             else self.stride != 1 or x.shape[-1] != self.features
         )
         if project:
-            identity = ConvNormAct(self.features, 1, self.stride, use_act=False)(x, mask)
+            identity = ConvNormAct(self.features, 1, self.stride,
+                                   use_act=False, dtype=dt)(x, mask)
         return nn.relu(y + identity)
 
 
@@ -220,6 +241,26 @@ ENCODER_ZOO = {
 }
 
 
+class StemConvNorm(nn.Module):
+    """The encoder's 7x7/2 stem conv + masked instance norm + relu.
+
+    Functionally the old ``ConvNormAct(stem_channels, 7, 2)`` — child names
+    (``Conv_0``/``InstanceNorm_0``) and param shapes are preserved — but
+    the conv is :class:`~deepinteract_tpu.models.stem.DeepLabStemConv`,
+    which also accepts ``PairFactors`` and then computes the stride-2 conv
+    without materializing the 2C pair tensor."""
+
+    features: int
+    dtype: Any = FLOAT32
+
+    @nn.compact
+    def __call__(self, x, mask):
+        y = DeepLabStemConv(self.features, kernel_size=7, stride=2,
+                            dtype=self.dtype, name="Conv_0")(x)
+        y = InstanceNorm(self.features, name="InstanceNorm_0")(y, mask)
+        return nn.relu(y)
+
+
 class ResNetEncoder(nn.Module):
     """Stem + 4 residual stages; returns (1/4-scale skip, 1/16-scale
     deep features) — the two taps DeepLabV3+ consumes
@@ -231,9 +272,15 @@ class ResNetEncoder(nn.Module):
     @nn.compact
     def __call__(self, x, mask):
         cfg = self.cfg
-        # Stem: 7x7/2 + 3x3/2 max pool (torchvision resnet layout).
+        dt = cfg.dtype
+        # Stem: 7x7/2 + 3x3/2 max pool (torchvision resnet layout). The
+        # stem block accepts the materialized pair tensor OR PairFactors
+        # (the factorized interaction stem, models/stem.py) with one param
+        # tree; the explicit name keeps the historical
+        # ConvNormAct_0/{Conv_0, InstanceNorm_0} checkpoint scope.
         m2 = _pool_mask(mask, 2)
-        x = ConvNormAct(cfg.stem_channels, 7, 2)(x, m2)
+        x = StemConvNorm(cfg.stem_channels, dtype=dt,
+                         name="ConvNormAct_0")(x, m2)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         m4 = _pool_mask(mask, 4)
         # Max pooling at the pad frontier picks up valid neighbors, making
@@ -241,7 +288,7 @@ class ResNetEncoder(nn.Module):
         # (every masked InstanceNorm re-zeroes after its conv, so this is
         # the one spot where unmasked values could smear into the valid
         # region).
-        x = x * m4[..., None]
+        x = x * m4[..., None].astype(x.dtype)
 
         skip = None
         m = m4
@@ -273,7 +320,7 @@ class ResNetEncoder(nn.Module):
                 )
                 x = block_cls(
                     feats, stride=stride if b == 0 else 1, dilation=dilation,
-                    use_projection=proj, name=f"stage{s}_block{b}",
+                    use_projection=proj, dtype=dt, name=f"stage{s}_block{b}",
                 )(x, m)
             if s == 0:
                 skip = x  # 1/4 scale high-res tap
@@ -290,20 +337,24 @@ class ASPP(nn.Module):
     @nn.compact
     def __call__(self, x, mask, train: bool):
         cfg = self.cfg
+        dt = cfg.dtype
         ch = cfg.decoder_channels
-        branches = [ConvNormAct(ch, 1)(x, mask)]
+        branches = [ConvNormAct(ch, 1, dtype=dt)(x, mask)]
         for rate in cfg.aspp_rates:
-            branches.append(SeparableConv(ch, dilation=rate)(x, mask))
-        # Masked global-average pooling branch.
-        m = mask[..., None].astype(x.dtype)
+            branches.append(SeparableConv(ch, dilation=rate, dtype=dt)(x, mask))
+        # Masked global-average pooling branch; the spatial mean
+        # accumulates in float32 (policy stats dtype).
+        m = mask[..., None].astype(STATS_DTYPE)
         count = jnp.maximum(jnp.sum(m, axis=(1, 2), keepdims=True), 1.0)
-        pooled = jnp.sum(x * m, axis=(1, 2), keepdims=True) / count
-        pooled = nn.relu(nn.Conv(ch, (1, 1), use_bias=False)(pooled))
+        pooled = (jnp.sum(x.astype(STATS_DTYPE) * m, axis=(1, 2),
+                          keepdims=True) / count).astype(x.dtype)
+        pooled = nn.relu(nn.Conv(ch, (1, 1), use_bias=False,
+                                 dtype=dt)(pooled))
         branches.append(jnp.broadcast_to(pooled, x.shape[:-1] + (ch,)))
 
         y = jnp.concatenate(branches, axis=-1)
-        y = ConvNormAct(ch, 1)(y, mask)
-        y = SeparableConv(ch)(y, mask)
+        y = ConvNormAct(ch, 1, dtype=dt)(y, mask)
+        y = SeparableConv(ch, dtype=dt)(y, mask)
         y = nn.Dropout(self.cfg.dropout_rate, deterministic=not train)(y)
         return y
 
@@ -317,22 +368,59 @@ class DeepLabDecoder(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
         cfg = self.cfg
-        b, h, w, _ = x.shape
-        if mask is None:
-            mask = jnp.ones((b, h, w), dtype=x.dtype)
-        mask = mask.astype(x.dtype)
+        dt = cfg.dtype
+        factored = isinstance(x, PairFactors)
+        if factored:
+            # Factorized interaction stem (models/stem.py): per-chain
+            # features/masks; the 2C pair tensor is never materialized —
+            # the stem conv consumes the factors directly and the first
+            # full-resolution map is the stride-2 stem output.
+            f1, f2 = x.feats1, x.feats2
+            b, h = f1.shape[0], f1.shape[1]
+            w = f2.shape[1]
+            m1 = (jnp.ones((b, h), dt) if x.mask1 is None
+                  else x.mask1.astype(dt))
+            m2 = (jnp.ones((b, w), dt) if x.mask2 is None
+                  else x.mask2.astype(dt))
+        else:
+            b, h, w, _ = x.shape
+            if mask is None:
+                mask = jnp.ones((b, h, w), dtype=dt)
+            mask = mask.astype(dt)
 
         # Pad to a multiple of the output stride; slice logits back at the
         # end (reference slices after upsampling, vision_modules.py:211-217).
         os_ = cfg.output_stride
         ph = (-h) % os_
         pw = (-w) % os_
-        if ph or pw:
-            x = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)))
-            mask = jnp.pad(mask, ((0, 0), (0, ph), (0, pw)))
-        x = x * mask[..., None]
+        if factored:
+            if ph or pw:
+                f1 = jnp.pad(f1, ((0, 0), (0, ph), (0, 0)))
+                m1 = jnp.pad(m1, ((0, 0), (0, ph)))
+                f2 = jnp.pad(f2, ((0, 0), (0, pw), (0, 0)))
+                m2 = jnp.pad(m2, ((0, 0), (0, pw)))
+            # The [B, H, W] pair mask is cheap (no channel dim) and drives
+            # every downstream pooled-mask statistic exactly as before. A
+            # caller-passed mask is honored (it must be a subset of the
+            # chain masks' outer product — the stem conv itself can only
+            # factorize the separable chain-mask form); None derives it.
+            if mask is not None:
+                if ph or pw:
+                    mask = jnp.pad(mask.astype(dt),
+                                   ((0, 0), (0, ph), (0, pw)))
+                else:
+                    mask = mask.astype(dt)
+            else:
+                mask = m1[:, :, None] * m2[:, None, :]
+            enc_in = PairFactors(f1.astype(dt), f2.astype(dt), m1, m2,
+                                 shard_pair=x.shard_pair)
+        else:
+            if ph or pw:
+                x = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)))
+                mask = jnp.pad(mask, ((0, 0), (0, ph), (0, pw)))
+            enc_in = x.astype(dt) * mask[..., None]
 
-        skip, m4, deep, m16 = ResNetEncoder(cfg)(x, mask)
+        skip, m4, deep, m16 = ResNetEncoder(cfg)(enc_in, mask)
         y = ASPP(cfg)(deep, m16, train)
 
         # Upsample x4, fuse with the 1x1-projected high-res skip, refine.
@@ -341,19 +429,21 @@ class DeepLabDecoder(nn.Module):
         # valid cells near the pad frontier, making logits depend on the
         # padding bucket — the unpadded reference has no such frontier.
         y = _masked_resize(y, m16, (skip.shape[1], skip.shape[2]))
-        hi = ConvNormAct(cfg.high_res_channels, 1)(skip, m4)
-        y = jnp.concatenate([y * m4[..., None], hi], axis=-1)
-        y = SeparableConv(cfg.decoder_channels)(y, m4)
-        y = SeparableConv(cfg.decoder_channels)(y, m4)
+        hi = ConvNormAct(cfg.high_res_channels, 1, dtype=dt)(skip, m4)
+        y = jnp.concatenate([y * m4[..., None].astype(y.dtype), hi], axis=-1)
+        y = SeparableConv(cfg.decoder_channels, dtype=dt)(y, m4)
+        y = SeparableConv(cfg.decoder_channels, dtype=dt)(y, m4)
 
-        # Segmentation head: 1x1 to classes, then upsample x4 to input size.
+        # Segmentation head: 1x1 to classes in float32 (the policy's
+        # output dtype), then upsample x4 to input size.
         logits = nn.Conv(
             cfg.num_classes, (1, 1),
             bias_init=_pos_bias_init(cfg.num_classes),
-        )(y)
-        logits = _masked_resize(logits, m4, (x.shape[1], x.shape[2]))
+        )(y.astype(OUTPUT_DTYPE))
+        full = (h + ph, w + pw)
+        logits = _masked_resize(logits, m4, full)
         logits = logits[:, :h, :w, :]
-        return logits * mask[:, :h, :w, None]
+        return logits * mask[:, :h, :w, None].astype(logits.dtype)
 
 
 def _masked_resize(y: jnp.ndarray, mask: jnp.ndarray, hw) -> jnp.ndarray:
@@ -370,7 +460,7 @@ def _masked_resize(y: jnp.ndarray, mask: jnp.ndarray, hw) -> jnp.ndarray:
 def _pos_bias_init(num_classes: int):
     """Positive-class logit bias -7 (deepinteract_modules.py:1224-1226)."""
 
-    def init(key, shape, dtype=jnp.float32):
+    def init(key, shape, dtype=OUTPUT_DTYPE):
         del key
         bias = jnp.zeros(shape, dtype)
         return bias.at[-1].set(-7.0) if num_classes == 2 else bias

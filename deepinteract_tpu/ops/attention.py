@@ -91,13 +91,19 @@ def edge_attention(
     """
     b, n, h, d = q.shape
     kk = nbr_idx.shape[-1]
+    f32 = jnp.float32
     score_vec = edge_scores(q, k, proj_e, nbr_idx, mode=mode)  # [B,N,K,H,D]
-    logits = jnp.clip(jnp.sum(score_vec, axis=-1), -CLIP, CLIP)  # [B,N,K,H]
-    weights = jnp.exp(logits) * edge_mask[..., None]
+    # Softmax accumulators in float32 regardless of the compute dtype
+    # (models/policy.py: exp/sum reductions are the bf16-unsafe part; with
+    # float32 inputs every cast here is the identity, so f32 numerics are
+    # unchanged). Values may stay bf16 — the weighted sums promote to f32.
+    logits = jnp.clip(jnp.sum(score_vec.astype(f32), axis=-1), -CLIP, CLIP)
+    weights = jnp.exp(logits) * edge_mask[..., None].astype(f32)  # [B,N,K,H]
 
     if mode == "gather":
         v_nbr = _gather_nodes(v, nbr_idx)  # [B,N,K,H,D]
-        wv = jnp.einsum("bnkh,bnkhd->bnhd", weights, v_nbr)
+        wv = jnp.einsum("bnkh,bnkhd->bnhd", weights, v_nbr,
+                        preferred_element_type=f32)
         z = jnp.sum(weights, axis=2)  # [B,N,H]
     else:
         # Scatter contributions of edge (i, k) onto its destination node.
@@ -111,6 +117,7 @@ def edge_attention(
 
         wv, z = jax.vmap(scatter_one)(weights, v, nbr_idx)
 
-    h_out = wv / (z[..., None] + EPS)
-    e_out = score_vec * edge_mask[..., None, None]
+    # Back to the caller's compute dtype (no-op under float32).
+    h_out = (wv / (z[..., None] + EPS)).astype(q.dtype)
+    e_out = score_vec * edge_mask[..., None, None].astype(score_vec.dtype)
     return h_out, e_out

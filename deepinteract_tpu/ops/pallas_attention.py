@@ -390,7 +390,11 @@ def _bwd(interpret, fwd_blocks, bwd_blocks, res, grads):
         q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out, dh, de, interpret,
         bwd_blocks,
     )
-    return dq, dk, dv, dpe, None, None
+    # The kernel computes (and returns) float32; cotangents must match the
+    # primals' dtypes — under a bf16 compute policy q/k/v/proj_e arrive
+    # bf16 while the f32 accumulation above stays intact.
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dpe.astype(proj_e.dtype), None, None)
 
 
 edge_attention_pallas.defvjp(_fwd, _bwd)

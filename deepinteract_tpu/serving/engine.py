@@ -95,6 +95,12 @@ class EngineConfig:
     # Zero all input features (the scientific-control path); part of the
     # result-cache key since it changes the output for the same upload.
     input_indep: bool = False
+    # Pin the model's configured interaction_stem / compute_dtype against
+    # tuned-entry adoption (cli/serve.py sets these when the operator
+    # typed the flags explicitly — a stored trial must not silently
+    # override them; the dtype additionally changes numerics).
+    pin_interaction_stem: bool = False
+    pin_compute_dtype: bool = False
     # Tuning-store path (tuning/store.py): when set, the engine resolves
     # the tuned config for its ACTIVE bucket (first warmup spec, else the
     # top bucket) BEFORE any AOT compile. Forward-relevant knobs are
@@ -191,6 +197,9 @@ class InferenceEngine:
         # at one symmetric bucket: the kernel runs at each chain's OWN
         # pad, so the grid applies only when legal at every padded length
         # this engine will compile (BOTH dims of every warmup bucket).
+        adopted = consume.respect_explicit(
+            adopted, stem=self.cfg.pin_interaction_stem,
+            dtype=self.cfg.pin_compute_dtype)
         adopted, blocks_note = consume.restrict_pallas_blocks(
             adopted,
             {p for spec in (self.cfg.warmup_buckets or ((b1, b2, bs),))
@@ -214,7 +223,19 @@ class InferenceEngine:
         self.adopted_tuning = adopted
         logger.info("autotune: serving adopts (%s) for bucket b%d_p%d%s%s",
                     adopted.summary(), bs, pad, scan_note, blocks_note)
-        return dataclasses.replace(base, gnn=gnn, decoder=decoder)
+        # Stem + compute-dtype are forward-relevant AND param-tree-
+        # preserving (models/stem.py keeps one tree for both stems; the
+        # dtype policy keeps params float32), so they adopt safely even
+        # under a pinned checkpoint. None = the trial left the knob at
+        # "caller's config" (tuning/space.py) — keep the engine's own.
+        base = dataclasses.replace(base, gnn=gnn, decoder=decoder)
+        if trial.interaction_stem is not None:
+            base = dataclasses.replace(
+                base, interaction_stem=trial.interaction_stem)
+        if trial.compute_dtype is not None:
+            base = dataclasses.replace(
+                base, compute_dtype=trial.compute_dtype)
+        return base
 
     # -- weights -----------------------------------------------------------
 
@@ -471,6 +492,13 @@ class InferenceEngine:
         return {
             "uptime_seconds": time.time() - self._started,
             "restored_from": self.restored_from,
+            # The served model's stem/precision configuration: what the
+            # AOT executables were actually compiled with.
+            "interaction_stem": self.model.cfg.interaction_stem,
+            "compute_dtype": {
+                "gnn": self.model.cfg.gnn.compute_dtype,
+                "decoder": self.model.cfg.decoder.compute_dtype,
+            },
             "tuning": {
                 "store": self.cfg.tuning_store,
                 "adopted": (self.adopted_tuning.summary()

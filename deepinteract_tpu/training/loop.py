@@ -155,6 +155,16 @@ class LoopConfig:
     # phase-labeled. None disables.
     profile_dir: Optional[str] = None
     profile_steps: int = 3
+    # -- input pipeline ----------------------------------------------------
+    # Issue jax.device_put of upcoming train batches on the loader's
+    # prefetch thread (data/loader.py device_transfer hook) so tele_h2d
+    # overlaps device_step instead of serializing before each dispatch.
+    # Engages only for single-device runs with steps_per_dispatch == 1:
+    # scanned dispatches np.stack K host batches into ONE placement
+    # (device arrays there would force K d2h round trips — see the h2d
+    # caveat in _run_train_epoch) and mesh runs place via shardings.
+    # Skipped-with-a-log-line otherwise. Off by default.
+    device_prefetch: bool = False
     # -- autotuning (tuning/) ---------------------------------------------
     # With autotune on and a store path set, the Trainer resolves the
     # tuned scan_k (steps_per_dispatch) for tuning_bucket = (batch, pad)
@@ -609,6 +619,8 @@ class Trainer:
                     state = _restore_into(
                         state, jax.tree_util.tree_map(np.asarray, tree))
 
+        self._install_device_prefetch(train_data)
+
         history: List[Dict[str, float]] = []
         epochs = num_epochs if num_epochs is not None else cfg.num_epochs
         t_start = time.time()
@@ -909,6 +921,38 @@ class Trainer:
         return state, history
 
     # -- internals ---------------------------------------------------------
+
+    def _install_device_prefetch(self, train_data: DataSource) -> None:
+        """Wire LoopConfig.device_prefetch into the loader's
+        ``device_transfer`` hook (data/loader.py): upcoming batches get
+        their ``jax.device_put`` issued on the prefetch thread, so the
+        h2d transfer overlaps the previous dispatch's device_step.
+
+        Only engages where it is correct AND useful — single device
+        (mesh runs place via shardings; a bare device_put would commit to
+        one device) with per-step dispatch (the scanned path np.stacks K
+        host batches into one placement; device-resident batches there
+        would pay K d2h round trips — the h2d caveat in
+        _run_train_epoch). Anything else logs the skip reason."""
+        if not self.cfg.device_prefetch:
+            return
+        if not hasattr(train_data, "device_transfer"):
+            self.log("device_prefetch: train data source has no "
+                     "device_transfer hook (not a BucketedLoader); skipped")
+            return
+        if self.mesh is not None:
+            self.log("device_prefetch skipped: mesh runs place batches "
+                     "via shardings (a bare device_put would commit to "
+                     "one device)")
+            return
+        if self.cfg.steps_per_dispatch > 1:
+            self.log("device_prefetch skipped: steps_per_dispatch > 1 "
+                     "stacks batches on host for the scanned dispatch "
+                     "(device-resident batches would round-trip d2h)")
+            return
+        train_data.device_transfer = jax.device_put
+        self.log("device_prefetch: h2d of upcoming batches issued on the "
+                 "loader's prefetch thread (double-buffered)")
 
     @staticmethod
     def _epoch_telemetry(epoch_stats: Dict[str, float], ckpt_s: float,

@@ -57,7 +57,9 @@ class Adopted:
             f"microbatch={c.microbatch}, "
             f"scan_chunks={c.scan_chunks}, "
             f"pallas_blocks=({c.pallas_fwd_blocks}, {c.pallas_bwd_blocks}), "
-            f"diagonal_buckets={c.diagonal_buckets} "
+            f"diagonal_buckets={c.diagonal_buckets}, "
+            f"stem={c.interaction_stem or 'kept-config'}, "
+            f"dtype={c.compute_dtype or 'kept-config'} "
             f"[{self.source}{', partial search' if self.partial else ''}]"
         )
 
@@ -131,6 +133,27 @@ def restrict_pallas_blocks(adopted: Optional[Adopted], pads,
                                    pallas_bwd_blocks=None))
     return stripped, (" (tuned Pallas grid NOT applied: illegal for at "
                       "least one bucket pad in the plan)")
+
+
+def respect_explicit(adopted: Optional[Adopted], *, stem: bool = False,
+                     dtype: bool = False):
+    """Strip the stem/precision knobs from an adoption when the operator
+    set them EXPLICITLY on the CLI (cli/args.py ``pinned_knobs``): a
+    stored trial then keeps its perf knobs but cannot silently override a
+    typed --interaction_stem / --compute_dtype (dtype is additionally an
+    accuracy-affecting knob). None fields already mean "keep the caller's
+    config" (tuning/space.py)."""
+    if adopted is None or not (stem or dtype):
+        return adopted
+    updates = {}
+    if stem and adopted.config.interaction_stem is not None:
+        updates["interaction_stem"] = None
+    if dtype and adopted.config.compute_dtype is not None:
+        updates["compute_dtype"] = None
+    if not updates:
+        return adopted
+    return dataclasses.replace(
+        adopted, config=dataclasses.replace(adopted.config, **updates))
 
 
 def adopt_model_config(model_cfg, adopted: Optional[Adopted]):

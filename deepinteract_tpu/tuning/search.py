@@ -150,6 +150,7 @@ class SuccessiveHalvingSearch:
         total_budget_s: Optional[float] = None,
         install_signal_handlers: bool = True,
         log: Callable[[str], None] = lambda _m: None,
+        baseline: Optional[TrialConfig] = None,
     ):
         self.measure = measure
         self.store = store
@@ -162,6 +163,12 @@ class SuccessiveHalvingSearch:
         self.total_budget_s = total_budget_s
         self.install_signal_handlers = install_signal_handlers
         self.log = log
+        # The trial whose measurement is "the default" for speedup
+        # reporting. Callers whose grid names config-dependent knobs
+        # concretely (the stem axis, tuning/space.py) must pass the
+        # concretized baseline — default_trial() alone would match no
+        # trial there.
+        self.baseline = canonicalize(baseline or default_trial())
         self._stop = threading.Event()
         self._stop_reason: Optional[str] = None
         self._t0 = time.monotonic()
@@ -230,8 +237,8 @@ class SuccessiveHalvingSearch:
             best = min(ok, key=lambda r: (-r.rung, r.value))
             entry["config"] = best.config.to_dict()
             entry["value"] = best.value
-            base = canonicalize(default_trial())
-            defaults = [r for r in ok if canonicalize(r.config) == base]
+            defaults = [r for r in ok
+                        if canonicalize(r.config) == self.baseline]
             if defaults:
                 entry["default_value"] = min(
                     defaults, key=lambda r: (-r.rung, r.value)).value
@@ -284,8 +291,7 @@ class SuccessiveHalvingSearch:
                 break  # inner break (stop/budget) propagates out
         ok = [r for r in results if r.status == "ok" and r.value is not None]
         best = min(ok, key=lambda r: (-r.rung, r.value)) if ok else None
-        base = canonicalize(default_trial())
-        defaults = [r for r in ok if canonicalize(r.config) == base]
+        defaults = [r for r in ok if canonicalize(r.config) == self.baseline]
         default_value = (min(defaults, key=lambda r: (-r.rung, r.value)).value
                          if defaults else None)
         partial = partial or self._stop.is_set()

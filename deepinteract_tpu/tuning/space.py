@@ -17,6 +17,15 @@ tuner — nothing is invented for tuning's sake:
   kernel's built-in heuristic).
 * ``diagonal_buckets`` — loader bucket diagonalization
   (``data/loader.py``; compile count vs pad FLOPs trade).
+* ``interaction_stem`` — factorized vs materialized first decoder layer
+  (``models/stem.py``; the pair-tensor HBM lever — same params, same
+  numerics up to float association; searched as concrete values, None =
+  keep the caller's config — the pinning sentinel only).
+* ``compute_dtype`` — the end-to-end activation dtype policy
+  (``models/policy.py``): a DECLARED axis (TrialConfig + adoption honor
+  it) that is not auto-searched — a latency-only objective would always
+  pick bf16 and silently flip an accuracy-affecting knob; see
+  ``axes_for_bucket``.
 
 The space is bucket- and device-aware: axes that cannot apply to a given
 ``(batch, pad)`` bucket (a Pallas grid the kernel rejects, a scan_k of 1
@@ -29,6 +38,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepinteract_tpu.models.stem import validate_stem
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +55,11 @@ class TrialConfig:
     pallas_fwd_blocks: Optional[int] = None
     pallas_bwd_blocks: Optional[int] = None
     diagonal_buckets: bool = False
+    # None on the stem/dtype axes means "keep the caller's configured
+    # value" — adoption must never silently override an explicit
+    # --interaction_stem / --compute_dtype with a searched default.
+    interaction_stem: Optional[str] = None
+    compute_dtype: Optional[str] = None
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -68,6 +84,10 @@ class TrialConfig:
             parts.append(f"pbwd={self.pallas_bwd_blocks}")
         if self.diagonal_buckets:
             parts.append("diag")
+        if self.interaction_stem is not None:
+            parts.append(f"stem-{self.interaction_stem}")
+        if self.compute_dtype is not None:
+            parts.append(self.compute_dtype)
         return ",".join(parts)
 
 
@@ -89,7 +109,8 @@ def default_trial() -> TrialConfig:
 
 def axes_for_bucket(batch: int, pad: int, device_kind: str = "cpu",
                     knn: int = 20, tune_pallas: Optional[bool] = None,
-                    include_loader_axis: bool = True) -> List[Axis]:
+                    include_loader_axis: bool = True,
+                    base_stem: str = "factorized") -> List[Axis]:
     """The applicable axes for one ``(batch, pad)`` bucket.
 
     ``tune_pallas`` defaults to "is this a TPU" — off-TPU the kernel runs
@@ -99,7 +120,14 @@ def axes_for_bucket(batch: int, pad: int, device_kind: str = "cpu",
     ``include_loader_axis=False`` drops ``diagonal_buckets`` — the
     single-bucket synthetic measurement cannot see its effect (it changes
     corpus-level compile counts and run lengths, not one step's time), so
-    only a corpus-aware caller should search it.
+    only a corpus-aware caller should search it. ``base_stem`` is the
+    caller's CONFIGURED interaction stem: the stem axis searches the two
+    CONCRETE stems, base first — stored trials must name the stem they
+    measured, because the store key (``model_signature``) deliberately
+    excludes the stem and a later consumer may be configured with the
+    OTHER one; a relative None would then silently resolve to a stem the
+    trial never ran. None stays reserved for "keep the caller's config"
+    (the pinning sentinel, ``consume.respect_explicit``).
     """
     if tune_pallas is None:
         tune_pallas = "TPU" in device_kind or "tpu" in device_kind
@@ -136,6 +164,23 @@ def axes_for_bucket(batch: int, pad: int, device_kind: str = "cpu",
     if include_loader_axis:
         axes.append(Axis("diagonal_buckets", (False, True),
                          "loader bucket diagonalization"))
+    # NOT searched: the compute_dtype (precision-policy) axis. Like the
+    # microbatch axis above it is part of the declared space (TrialConfig
+    # field + apply_to_model_config honor it), but the ms-per-step
+    # objective cannot judge it fairly — bf16 nearly always wins pure
+    # step time while changing the numerics, so a latency-only search
+    # would silently flip an accuracy-affecting knob. bench's
+    # precision_ab section is the evidence surface; an operator (or a
+    # future accuracy-aware objective) can still store entries with it
+    # set. interaction_stem IS searched: the two stems are numerics-
+    # equivalent (tests/test_stem.py parity), so a speed objective judges
+    # them fairly — and always as concrete values, so the persisted
+    # winner is base-config-independent (see the docstring).
+    other_stem = ("materialized" if validate_stem(base_stem) == "factorized"
+                  else "factorized")
+    axes.append(Axis("interaction_stem", (base_stem, other_stem),
+                     f"first decoder layer: the configured {base_stem} stem "
+                     f"vs {other_stem} (models/stem.py)"))
     return axes
 
 
@@ -179,7 +224,10 @@ def enumerate_trials(axes: Sequence[Axis], max_trials: int = 64,
 
 def canonicalize(trial: TrialConfig) -> TrialConfig:
     """Collapse don't-care fields so physically identical configs compare
-    equal (remat off => policy irrelevant)."""
+    equal (remat off => policy irrelevant). The stem axis needs no
+    collapsing here: ``axes_for_bucket`` searches the two concrete stems,
+    so no value aliases another (None appears only in pinned/hand-written
+    configs, never in a search grid)."""
     if not trial.remat:
         return dataclasses.replace(trial, remat_policy="full")
     return trial
@@ -192,7 +240,8 @@ def canonicalize(trial: TrialConfig) -> TrialConfig:
 
 def apply_to_model_config(model_cfg, trial: TrialConfig):
     """A new ``ModelConfig`` with the trial's model-side knobs applied
-    (decoder remat/policy/scan_chunks, Pallas block grid)."""
+    (decoder remat/policy/scan_chunks, Pallas block grid, interaction
+    stem, compute-dtype policy)."""
     decoder = dataclasses.replace(
         model_cfg.decoder,
         remat=trial.remat,
@@ -204,7 +253,17 @@ def apply_to_model_config(model_cfg, trial: TrialConfig):
         pallas_fwd_blocks=trial.pallas_fwd_blocks,
         pallas_bwd_blocks=trial.pallas_bwd_blocks,
     )
-    return dataclasses.replace(model_cfg, decoder=decoder, gnn=gnn)
+    out = dataclasses.replace(model_cfg, decoder=decoder, gnn=gnn)
+    # None = keep the caller's configured stem/precision: an explicit
+    # --interaction_stem/--compute_dtype must never be silently
+    # overridden by a searched default.
+    if trial.interaction_stem is not None:
+        out = dataclasses.replace(out, interaction_stem=trial.interaction_stem)
+    if trial.compute_dtype is not None:
+        # The model-level policy pushes the dtype into every sub-config
+        # (ModelConfig.__post_init__).
+        out = dataclasses.replace(out, compute_dtype=trial.compute_dtype)
+    return out
 
 
 def apply_to_loop_config(loop_cfg, trial: TrialConfig):
@@ -220,15 +279,16 @@ def model_signature(model_cfg) -> str:
     """Stable signature of the ARCHITECTURE a tuning entry applies to.
 
     Deliberately excludes the tunable axes themselves (remat, scan_chunks,
-    Pallas blocks) — a tuned and a default build of the same model must
-    share one store entry — and includes everything that changes the
-    compiled graphs' math: layer counts, widths, heads, decoder
-    chunks/channels, compute dtype, attention mode, module type."""
+    Pallas blocks, interaction stem, compute dtype — the last two became
+    searched axes with the factorized-stem/bf16-policy work, so tuned and
+    default builds of one model share one store entry) and includes
+    everything else that changes the compiled graphs' math: layer counts,
+    widths, heads, decoder chunks/channels, attention mode, module type."""
     g, d = model_cfg.gnn, model_cfg.decoder
     return (
         f"{model_cfg.gnn_layer_type}-{model_cfg.interact_module_type}"
         f"-gl{g.num_layers}h{g.hidden}a{g.num_heads}-{g.attention_mode}"
-        f"-il{d.num_chunks}c{d.num_channels}-{d.compute_dtype}"
+        f"-il{d.num_chunks}c{d.num_channels}"
         + ("-tiled" if model_cfg.tile_pair_map else "")
     )
 

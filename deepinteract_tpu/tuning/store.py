@@ -1,9 +1,9 @@
 """Versioned on-disk tuning store: JSON, atomic writes, replicated reads.
 
-Schema (``SCHEMA_VERSION`` = 1)::
+Schema (``SCHEMA_VERSION`` = 2)::
 
     {
-      "schema_version": 1,
+      "schema_version": 2,
       "created": <wall-clock s of first write>,
       "entries": {
         "<device_kind>|<jax_version>|<model_signature>|<bucket>": {
@@ -51,7 +51,11 @@ from typing import Dict, Optional
 
 from deepinteract_tpu.tuning.space import TrialConfig
 
-SCHEMA_VERSION = 1
+# 2 (r6): model_signature dropped its compute_dtype suffix when the dtype
+# became a tunable knob (tuning/space.py) — entry keys changed format, so
+# v1 stores must be rejected loudly (re-run cli.tune), not silently
+# unmatched with their tuned knobs reverting to defaults.
+SCHEMA_VERSION = 2
 
 DEFAULT_STORE_BASENAME = "tuning_store.json"
 

@@ -147,6 +147,21 @@ def time_compiled(fn, args, iters: int = DEFAULT_ITERS,
         flops = float(cost.get("flops", 0.0)) or None
     except Exception:
         pass
+    memory = None
+    try:
+        # Compiled memory footprint rides along in the timing dict (the
+        # compiled object never leaves this function): temp bytes are the
+        # activation working set — what the factorized interaction stem
+        # exists to shrink (bench's per-bucket `interaction_bytes`).
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            memory = {
+                "temp_size_in_bytes": int(ma.temp_size_in_bytes),
+                "argument_size_in_bytes": int(ma.argument_size_in_bytes),
+                "output_size_in_bytes": int(ma.output_size_in_bytes),
+            }
+    except Exception:
+        pass
 
     variants = arg_variants(args, 4)
 
@@ -185,6 +200,8 @@ def time_compiled(fn, args, iters: int = DEFAULT_ITERS,
         "clamped_samples": clamped,
         "protocol": "differenced+host-fetch",
     }
+    if memory is not None:
+        timing["memory"] = memory
     return compile_s, timing, flops
 
 

@@ -1,0 +1,52 @@
+"""Fast-tier wiring of tools/check_dtype_discipline.py: models/ must not
+hardcode float dtypes outside models/policy.py (the dtype policy is the
+single precision authority — stray jnp.float32 casts are exactly the
+"f32 islands" that neutralized bf16 in the pre-r6 decoder)."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_no_hardcoded_dtypes_in_models():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_dtype_discipline.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"hardcoded dtypes crept into models/:\n{proc.stdout}{proc.stderr}")
+
+
+def test_checker_flags_real_violations(tmp_path):
+    """The check must actually detect — strings like compute_dtype=
+    'float32' and policy.py itself must NOT count."""
+    pkg = tmp_path / "models"
+    pkg.mkdir()
+    (pkg / "policy.py").write_text(
+        "import jax.numpy as jnp\nF32 = jnp.float32\n")
+    (pkg / "bad.py").write_text(
+        "import jax.numpy as jnp\n"
+        "import jax\n"
+        "def f(x):\n"
+        "    y = x.astype(jnp.float32)\n"       # violation (cast)
+        "    z = jnp.zeros((2,), jax.numpy.bfloat16)\n"  # violation (alias)
+        "    name = 'float32'\n"                 # fine: config string
+        "    return y, z, name\n")
+    (pkg / "good.py").write_text(
+        "from .policy import F32\n"
+        "def g(x):\n"
+        "    return x.astype(F32)\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_dtype_discipline.py"),
+         "--root", str(pkg)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "bad.py:4" in proc.stdout
+    assert "bad.py:5" in proc.stdout
+    assert "bad.py:6" not in proc.stdout  # string compare is fine
+    # policy.py is exempt (its jnp.float32 on line 2 must not be flagged;
+    # the violation hint text mentions 'policy.py' by name, so match the
+    # path:line form a real finding would use).
+    assert "policy.py:2" not in proc.stdout
+    assert "good.py:" not in proc.stdout

@@ -864,3 +864,46 @@ def test_loader_skip_budget_increments_registry_counter(toy_data):
     batches = list(loader.iter_epoch(0))
     assert len(batches) == 2  # one batch dropped within budget
     assert skipped_batches.value() == before + 1
+
+
+def test_nonfinite_guard_fires_under_bf16_policy():
+    """ISSUE-5 satellite: the on-device non-finite guard must still catch
+    poisoned batches when the real model computes in bfloat16 end to end
+    (models/policy.py keeps loss/grads float32, so the finiteness check
+    sees the same dtypes as before — this pins that the bf16 graph still
+    routes NaNs into it rather than flushing them)."""
+    from deepinteract_tpu.data.graph import stack_complexes
+    from deepinteract_tpu.data.synthetic import random_complex
+    from deepinteract_tpu.models.decoder import DecoderConfig
+    from deepinteract_tpu.models.geometric_transformer import GTConfig
+    from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    rng = np.random.default_rng(11)
+    data = [stack_complexes([random_complex(7, 6, rng=rng, n_pad1=8,
+                                            n_pad2=8, knn=4,
+                                            geo_nbrhd_size=2)])
+            for _ in range(3)]
+    model = DeepInteract(ModelConfig(
+        gnn=GTConfig(num_layers=1, hidden=8, num_heads=2, shared_embed=4,
+                     disable_geometric_mode=True),
+        decoder=DecoderConfig(num_chunks=1, num_channels=4,
+                              dilation_cycle=(1,)),
+        compute_dtype="bfloat16",
+    ))
+    faults.configure({"train.nan_batch": [2]})  # poison the 2nd batch
+    trainer = Trainer(
+        model,
+        LoopConfig(num_epochs=1, log_every=0, patience=50,
+                   eval_batches_per_dispatch=1),
+        OptimConfig(lr=1e-3, steps_per_epoch=3, num_epochs=1),
+        log_fn=lambda s: None,
+    )
+    state = trainer.init_state(data[0])
+    state, history = trainer.fit(state, data)
+    # 3 batches, one skipped: two optimizer steps, skip visible, epoch
+    # mean finite.
+    assert int(state.step) == 2
+    assert history[0]["train_skipped_steps"] == 1.0
+    assert math.isfinite(history[0]["train_loss"])

@@ -131,11 +131,31 @@ def test_lookup_bucket_fallback_drops_scan_k(tmp_path):
 def test_space_enumeration_default_first_dedup():
     axes = axes_for_bucket(1, 128, "cpu", include_loader_axis=True)
     trials = enumerate_trials(axes, max_trials=64)
-    assert trials[0] == canonicalize(default_trial())
+    # The first trial is the physical baseline: every knob at its
+    # default, the stem named concretely (see
+    # test_space_stem_axis_concrete).
+    assert trials[0] == canonicalize(dataclasses.replace(
+        default_trial(), interaction_stem="factorized"))
     assert len(set(trials)) == len(trials)  # deduplicated
     # remat=False collapses the remat_policy axis — no duplicated configs
     # differing only in a dead field.
     assert all(t.remat_policy == "full" for t in trials if not t.remat)
+
+
+def test_space_stem_axis_concrete():
+    """The stem axis must search CONCRETE stems (base first): the store
+    key (model_signature) excludes the stem, so a persisted trial whose
+    stem were a relative None would be re-interpreted against whatever
+    stem a LATER consumer happens to be configured with — adopting a
+    config the tuner never measured. None stays reserved for the pinning
+    sentinel (consume.respect_explicit)."""
+    for base in ("factorized", "materialized"):
+        axes = {a.name: a for a in axes_for_bucket(1, 128, "cpu",
+                                                   base_stem=base)}
+        values = axes["interaction_stem"].values
+        assert None not in values
+        assert values[0] == base
+        assert set(values) == {"factorized", "materialized"}
 
 
 def test_space_p256_forces_remat():

@@ -1,0 +1,93 @@
+"""Static check: no hardcoded float dtypes in ``models/`` outside
+``models/policy.py``.
+
+The dtype policy (``deepinteract_tpu/models/policy.py``) is the single
+place model code may name a precision: statistics accumulate in
+``STATS_DTYPE``, outward-facing tensors are ``OUTPUT_DTYPE``, activations
+follow the configured compute dtype. A stray ``jnp.float32`` cast inside
+a model silently pins part of the graph to full precision (the pre-r6
+decoder had exactly such islands, which neutralized bf16 until they were
+hunted down one by one) — or worse, a stray ``jnp.bfloat16`` bypasses the
+policy's float32 guarantees for params/norms/logits.
+
+AST-based (not grep): only real attribute references to the dtype names
+on the ``jnp`` / ``np`` / ``jax.numpy`` / ``numpy`` modules count —
+strings mentioning 'float32' (config values like
+``compute_dtype="float32"``) and comparisons against those strings do
+not. Run directly or via the fast-tier test
+``tests/test_dtype_discipline.py``::
+
+    python tools/check_dtype_discipline.py        # exit 1 + report
+    python tools/check_dtype_discipline.py --root path/to/models
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import sys
+from typing import Iterator
+
+# Files inside the scanned root where naming a dtype is the point.
+ALLOWED_FILES = {"policy.py"}
+
+# Forbidden attribute names on a numpy-ish module object.
+DTYPE_ATTRS = {"float32", "bfloat16", "float16", "float64"}
+
+# Module aliases whose dtype attributes count as hardcoding.
+NUMPY_MODULES = {"jnp", "np", "numpy"}
+
+
+def _is_numpy_module(node: ast.expr) -> bool:
+    """True for ``jnp`` / ``np`` / ``numpy`` names and ``jax.numpy``."""
+    if isinstance(node, ast.Name):
+        return node.id in NUMPY_MODULES
+    if isinstance(node, ast.Attribute):  # jax.numpy
+        return (isinstance(node.value, ast.Name)
+                and node.value.id == "jax" and node.attr == "numpy")
+    return False
+
+
+def iter_violations(models_root: pathlib.Path) -> Iterator[str]:
+    for path in sorted(models_root.rglob("*.py")):
+        if path.name in ALLOWED_FILES:
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"),
+                             filename=str(path))
+        except SyntaxError as exc:
+            yield f"{path}:{exc.lineno or 0}: unparseable ({exc.msg})"
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in DTYPE_ATTRS
+                    and _is_numpy_module(node.value)):
+                yield (f"{path}:{node.lineno}: hardcoded dtype "
+                       f"'{ast.unparse(node)}' — import it from "
+                       "models/policy.py (STATS_DTYPE / OUTPUT_DTYPE / "
+                       "FLOAT32 / compute_dtype()) so precision has one "
+                       "authority")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    default_root = (pathlib.Path(__file__).resolve().parents[1]
+                    / "deepinteract_tpu" / "models")
+    parser.add_argument("--root", type=pathlib.Path, default=default_root,
+                        help="models directory to scan")
+    args = parser.parse_args(argv)
+    if not args.root.is_dir():
+        print(f"error: {args.root} is not a directory", file=sys.stderr)
+        return 2
+    violations = list(iter_violations(args.root))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} hardcoded dtype reference(s) found")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
