@@ -238,6 +238,50 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
                    help="per-request wait bound inside the HTTP handler")
 
 
+def add_screening_args(p: argparse.ArgumentParser) -> None:
+    """Bulk-screening surface (cli/screen.py; deepinteract_tpu.screening)."""
+    g = p.add_argument_group("screening")
+    g.add_argument("--chains_npz_dir", type=str, default=None,
+                   help="directory of complex .npz files; each contributes "
+                        "its two chains (<stem>:g1, <stem>:g2) to the "
+                        "library")
+    g.add_argument("--chains_pack_dir", type=str, default=None,
+                   help="pre-padded memmap pack (data/packed.py) to split "
+                        "into library chains")
+    g.add_argument("--synthetic_chains", type=int, default=0,
+                   help="generate N deterministic synthetic chains instead "
+                        "of reading a library (smoke tests / benches)")
+    g.add_argument("--synthetic_len", type=str, default="24,48",
+                   help="LO,HI residue-count range for --synthetic_chains")
+    g.add_argument("--query", type=str, default=None,
+                   help="comma list of chain ids: score query-vs-library "
+                        "instead of all-vs-all")
+    g.add_argument("--include_self", action="store_true",
+                   help="score the diagonal too (homodimer screening)")
+    g.add_argument("--max_pairs", type=int, default=0,
+                   help="truncate the pair list (0 = score everything)")
+    g.add_argument("--top_k", type=int, default=10,
+                   help="contact probabilities per pair summary; the "
+                        "ranking score is their mean "
+                        "(screening/scoring.py — the same helper behind "
+                        "predict --top_k)")
+    g.add_argument("--screen_batch", type=int, default=8,
+                   help="pairs per decode dispatch (and chains per "
+                        "encoder dispatch)")
+    g.add_argument("--emb_cache_entries", type=int, default=4096,
+                   help="in-memory embedding-cache capacity (chains)")
+    g.add_argument("--emb_cache_dir", type=str, default=None,
+                   help="spill directory for embeddings evicted from "
+                        "memory (npz per chain; reloaded transparently)")
+    g.add_argument("--out", type=str, default="screen_out",
+                   help="output prefix: <out>.jsonl (ranked records) and "
+                        "<out>.csv are written; the manifest defaults to "
+                        "<out>.manifest.json")
+    g.add_argument("--manifest", type=str, default=None,
+                   help="progress-ledger path (atomic per-batch flush; an "
+                        "existing matching manifest resumes the screen)")
+
+
 def add_tuning_args(p: argparse.ArgumentParser) -> None:
     """Autotuning surface shared by train/serve/tune (tuning/)."""
     g = p.add_argument_group("autotuning")

@@ -34,6 +34,13 @@ def main(argv=None) -> int:
     parser.add_argument("--save_npz", type=str, default=None,
                         help="also persist the featurized complex here")
     parser.add_argument("--output_dir", type=str, default=".")
+    parser.add_argument("--top_k", type=int, default=0,
+                        help="also rank the K most probable contacts "
+                             "(screening/scoring.py pair_summary — the "
+                             "same helper bulk screening ranks with): "
+                             "writes top_contacts.json and makes the "
+                             "final stdout line a machine-readable JSON "
+                             "summary")
     args = parser.parse_args(argv)
     if not args.input_npz and not (args.left_pdb and args.right_pdb):
         parser.error("provide --input_npz or both --left_pdb and --right_pdb")
@@ -104,6 +111,27 @@ def main(argv=None) -> int:
         np.save(path, a)
         saved.append(path)
     print("saved:", ", ".join(saved))
+    if args.top_k > 0:
+        import json
+
+        from deepinteract_tpu.screening.scoring import pair_summary
+
+        summary = pair_summary(probs, args.top_k)
+        contacts_path = os.path.join(args.output_dir, "top_contacts.json")
+        with open(contacts_path, "w") as fh:
+            json.dump(summary, fh, indent=1)
+        # Final stdout line is machine-readable, mirroring screen/tune/
+        # bench contract discipline (tools/check_cli_contract.py).
+        print(json.dumps({
+            "metric": "pair_score_topk_mean",
+            "value": round(summary["score"], 6),
+            "unit": "probability",
+            "top_k": summary["top_k"],
+            "max_prob": round(summary["max_prob"], 6),
+            "n1": n1, "n2": n2,
+            "top_contacts_out": contacts_path,
+            "contact_map_out": out,
+        }), flush=True)
     return 0
 
 

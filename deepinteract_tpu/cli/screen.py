@@ -1,0 +1,180 @@
+"""Screen CLI — bulk all-vs-all (or query-vs-library) chain-pair scoring.
+
+The docking-funnel workload: rank candidate interface partners across a
+chain library with N encoder passes + N^2 micro-batched decodes instead
+of N^2 full forwards (``deepinteract_tpu.screening``)::
+
+    # all-vs-all over a directory of complex npz files
+    python -m deepinteract_tpu.cli.screen --chains_npz_dir complexes/ \
+        --ckpt_name ckpts/run1 --out runs/screen1
+
+    # 12-chain synthetic smoke (no data, no checkpoint)
+    python -m deepinteract_tpu.cli.screen --synthetic_chains 12 --out /tmp/s
+
+Outputs: ``<out>.jsonl`` (ranked pair records, best first), ``<out>.csv``
+(spreadsheet-friendly columns), and an atomically-checkpointed manifest.
+A SIGTERM'd screen exits 0 with everything scored so far durable; the
+same command resumes and completes the remaining pairs exactly once.
+
+The FINAL stdout line is a machine-readable JSON contract
+(tools/check_cli_contract.py): metric/value/unit plus pair counts, the
+encode-reuse ratio and embedding-cache hit rate.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+
+from deepinteract_tpu.cli.args import (
+    add_screening_args,
+    build_parser,
+    configs_from_args,
+)
+
+
+def build_library(args):
+    from deepinteract_tpu.screening import ChainLibrary
+
+    sources = [bool(args.chains_npz_dir), bool(args.chains_pack_dir),
+               args.synthetic_chains > 0]
+    if sum(sources) != 1:
+        raise SystemExit("provide exactly one of --chains_npz_dir, "
+                         "--chains_pack_dir, --synthetic_chains")
+    if args.chains_npz_dir:
+        return ChainLibrary.from_npz_dir(args.chains_npz_dir)
+    if args.chains_pack_dir:
+        return ChainLibrary.from_pack(args.chains_pack_dir)
+    lo, hi = (int(v) for v in args.synthetic_len.split(","))
+    return ChainLibrary.synthetic(args.synthetic_chains, lo, hi,
+                                  seed=args.seed)
+
+
+def write_outputs(out_prefix: str, records) -> dict:
+    """Ranked JSONL + CSV; returns their paths."""
+    d = os.path.dirname(os.path.abspath(out_prefix))
+    os.makedirs(d, exist_ok=True)
+    jsonl_path = out_prefix + ".jsonl"
+    with open(jsonl_path + ".tmp", "w") as fh:
+        for rank, rec in enumerate(records, start=1):
+            fh.write(json.dumps({"rank": rank, **rec}) + "\n")
+    os.replace(jsonl_path + ".tmp", jsonl_path)
+    csv_path = out_prefix + ".csv"
+    with open(csv_path + ".tmp", "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["rank", "pair_id", "chain1", "chain2", "n1", "n2",
+                    "score", "max_prob", "top_k"])
+        for rank, rec in enumerate(records, start=1):
+            w.writerow([rank, rec["pair_id"], rec["chain1"], rec["chain2"],
+                        rec["n1"], rec["n2"], f"{rec['score']:.6f}",
+                        f"{rec['max_prob']:.6f}", rec["top_k"]])
+    os.replace(csv_path + ".tmp", csv_path)
+    return {"jsonl": jsonl_path, "csv": csv_path}
+
+
+def main(argv=None) -> int:
+    parser = build_parser(__doc__)
+    add_screening_args(parser)
+    args = parser.parse_args(argv)
+
+    import time
+
+    from deepinteract_tpu.robustness.preemption import PreemptionGuard
+    from deepinteract_tpu.screening import (
+        EmbeddingCache,
+        ScreenConfig,
+        ScreenManifest,
+        ScreenRunner,
+        enumerate_pairs,
+    )
+    from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+    from deepinteract_tpu.tuning.compile_cache import (
+        enable_compile_cache,
+        resolve_cache_dir,
+    )
+
+    enable_compile_cache(
+        resolve_cache_dir(args.compile_cache_dir,
+                          args.ckpt_name or args.ckpt_dir))
+
+    library = build_library(args)
+    pairs = enumerate_pairs(
+        library,
+        queries=(args.query.split(",") if args.query else None),
+        include_self=args.include_self,
+        max_pairs=args.max_pairs)
+    print(f"screen: {len(library)} chains, {len(pairs)} pairs "
+          f"(signature {library.signature()})", flush=True)
+
+    model_cfg, _, _ = configs_from_args(args)
+    engine = InferenceEngine(
+        model_cfg,
+        ckpt_dir=args.ckpt_name,
+        cfg=EngineConfig(
+            max_batch=args.screen_batch,
+            result_cache_size=0,  # screening never replays whole pairs
+            diagonal_buckets=args.diagonal_buckets,
+            pad_to_max_bucket=args.pad_to_max_bucket,
+            input_indep=args.input_indep,
+        ),
+        seed=args.seed,
+        metric_to_track=args.metric_to_track,
+    )
+    runner = ScreenRunner(
+        engine,
+        cache=EmbeddingCache(capacity=args.emb_cache_entries,
+                             spill_dir=args.emb_cache_dir),
+        cfg=ScreenConfig(top_k=args.top_k, decode_batch=args.screen_batch,
+                         encode_batch=args.screen_batch))
+
+    manifest_path = args.manifest or (args.out + ".manifest.json")
+    manifest, resumed = ScreenManifest.load_or_create(
+        manifest_path, library.signature(), len(pairs))
+    if resumed:
+        print(f"screen: resuming — {len(manifest.completed)}/{len(pairs)} "
+              f"pairs already scored in {manifest_path}", flush=True)
+
+    t0 = time.perf_counter()
+    with PreemptionGuard(log=lambda m: print(m, flush=True)) as guard:
+        result = runner.screen(library, pairs, manifest=manifest,
+                               guard=guard)
+    elapsed = time.perf_counter() - t0
+
+    paths = write_outputs(args.out, result.records)
+    if result.preempted:
+        print(f"screen: preempted with {result.pairs_scored} pairs scored "
+              f"this run ({len(manifest.completed)}/{len(pairs)} total "
+              "durable); rerun the same command to finish", flush=True)
+    pps = result.pairs_scored / elapsed if elapsed > 0 else 0.0
+    contract = {
+        "metric": "screen_pairs_per_sec",
+        "value": round(pps, 3),
+        "unit": "pairs/s",
+        "chains": result.chains,
+        "pairs_total": len(pairs),
+        "pairs_scored": result.pairs_scored,
+        "pairs_resumed": result.pairs_resumed,
+        "encode_reuse_ratio": round(result.encode_reuse_ratio, 2),
+        "emb_cache_hit_rate": result.summary()["emb_cache_hit_rate"],
+        "decode_batches": result.decode_batches,
+        "elapsed_s": round(elapsed, 3),
+        "preempted": result.preempted,
+        "resumed": result.resumed,
+        "ranked_out": paths["jsonl"],
+        "csv_out": paths["csv"],
+        "manifest": manifest_path,
+        "top_pair": (
+            {k: result.records[0][k]
+             for k in ("pair_id", "score", "max_prob")}
+            if result.records else None),
+    }
+    # FINAL stdout line = the machine-readable contract
+    # (tools/check_cli_contract.py keeps this un-regressable).
+    print(json.dumps(contract), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,6 +180,56 @@ class DeepInteract(nn.Module):
         node_feats, edge_feats = self.gnn(graph, x, train=train)
         return node_feats, edge_feats
 
+    def decode(self, feats1, feats2, mask1, mask2, train: bool = False):
+        """Interaction stem + decoder over already-encoded chain features:
+        the second phase of the split forward. ``__call__`` is exactly
+        ``decode(encode(g1), encode(g2))``, so the split-phase serving path
+        (screening's cached embeddings — ``serving/engine.py``) matches the
+        monolithic forward by construction. ``feats1``/``feats2`` are
+        ``[..., L, C]`` encoder outputs, ``mask1``/``mask2`` the ``[..., L]``
+        node-validity masks. Inputs are cast to the encoder's compute dtype
+        (embeddings cached as float32 round-trip losslessly from bfloat16)."""
+        feats1 = jnp.asarray(feats1, dtype=self.cfg.gnn.dtype)
+        feats2 = jnp.asarray(feats2, dtype=self.cfg.gnn.dtype)
+        l1, l2 = feats1.shape[-2], feats2.shape[-2]
+        factorized = self.cfg.interaction_stem == "factorized"
+        if self.cfg.tile_pair_map and (
+            l1 > self.cfg.tile_size or l2 > self.cfg.tile_size
+        ):
+            from deepinteract_tpu.models.tiled import tiled_decode
+
+            return tiled_decode(
+                self.decoder, feats1, feats2,
+                mask1, mask2,
+                tile=self.cfg.tile_size, train=train,
+                shard_pair_axis=self.cfg.shard_pair_map,
+                stem=self.cfg.interaction_stem,
+            )
+        if factorized:
+            # Factorized stem (models/stem.py): the decoder's first layer
+            # is computed from per-chain factors — the [B, L1, L2, 2C]
+            # interaction tensor is never materialized. The pair mask is
+            # built (and, under context parallelism, sharding-annotated)
+            # here; the stem annotates its own broadcast output.
+            pm = pair_mask(mask1, mask2)
+            if self.cfg.shard_pair_map:
+                from deepinteract_tpu.models.stem import shard_pair_rows
+
+                pm = shard_pair_rows(pm)
+            factors = PairFactors(
+                feats1, feats2, mask1, mask2,
+                shard_pair=self.cfg.shard_pair_map,
+            )
+            return self.decoder(factors, pm, train=train)
+        pm = pair_mask(mask1, mask2)
+        tensor = interaction_tensor(feats1, feats2)
+        if self.cfg.shard_pair_map:
+            from deepinteract_tpu.models.stem import shard_pair_rows
+
+            tensor = shard_pair_rows(tensor)
+            pm = shard_pair_rows(pm)
+        return self.decoder(tensor, pm, train=train)
+
     def __call__(
         self,
         graph1: ProteinGraph,
@@ -189,46 +239,8 @@ class DeepInteract(nn.Module):
     ):
         feats1, efeats1 = self.encode(graph1, train=train)
         feats2, efeats2 = self.encode(graph2, train=train)
-
-        l1, l2 = feats1.shape[-2], feats2.shape[-2]
-        factorized = self.cfg.interaction_stem == "factorized"
-        if self.cfg.tile_pair_map and (
-            l1 > self.cfg.tile_size or l2 > self.cfg.tile_size
-        ):
-            from deepinteract_tpu.models.tiled import tiled_decode
-
-            logits = tiled_decode(
-                self.decoder, feats1, feats2,
-                graph1.node_mask, graph2.node_mask,
-                tile=self.cfg.tile_size, train=train,
-                shard_pair_axis=self.cfg.shard_pair_map,
-                stem=self.cfg.interaction_stem,
-            )
-        elif factorized:
-            # Factorized stem (models/stem.py): the decoder's first layer
-            # is computed from per-chain factors — the [B, L1, L2, 2C]
-            # interaction tensor is never materialized. The pair mask is
-            # built (and, under context parallelism, sharding-annotated)
-            # here; the stem annotates its own broadcast output.
-            pm = pair_mask(graph1.node_mask, graph2.node_mask)
-            if self.cfg.shard_pair_map:
-                from deepinteract_tpu.models.stem import shard_pair_rows
-
-                pm = shard_pair_rows(pm)
-            factors = PairFactors(
-                feats1, feats2, graph1.node_mask, graph2.node_mask,
-                shard_pair=self.cfg.shard_pair_map,
-            )
-            logits = self.decoder(factors, pm, train=train)
-        else:
-            pm = pair_mask(graph1.node_mask, graph2.node_mask)
-            tensor = interaction_tensor(feats1, feats2)
-            if self.cfg.shard_pair_map:
-                from deepinteract_tpu.models.stem import shard_pair_rows
-
-                tensor = shard_pair_rows(tensor)
-                pm = shard_pair_rows(pm)
-            logits = self.decoder(tensor, pm, train=train)
+        logits = self.decode(feats1, feats2,
+                             graph1.node_mask, graph2.node_mask, train=train)
 
         if return_representations:
             return logits, {

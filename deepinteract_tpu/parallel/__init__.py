@@ -9,6 +9,7 @@ distributed generalization of the reference's 256x256 subsequencing tiles).
 
 from deepinteract_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    mesh_context,
     replicate,
     shard_batch,
     shard_stacked_batch,

@@ -19,6 +19,22 @@ DATA_AXIS = "data"
 PAIR_AXIS = "pair"
 
 
+def mesh_context(mesh: Mesh):
+    """Version-portable ``with mesh_context(mesh):`` activation.
+
+    ``jax.set_mesh`` (the current API) only exists from jax 0.6; older
+    releases spell it ``jax.sharding.use_mesh`` (0.4.35+, experimental) or
+    rely on ``Mesh`` itself being a context manager (the 0.4.x legacy
+    global-mesh context). All three establish the ambient mesh the
+    sharded-step helpers and tests need; callers must not depend on the
+    newer API's extra behaviors (e.g. implicit out-sharding inference)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    if hasattr(jax.sharding, "use_mesh"):
+        return jax.sharding.use_mesh(mesh)
+    return mesh
+
+
 def make_mesh(
     num_data: Optional[int] = None,
     num_pair: int = 1,

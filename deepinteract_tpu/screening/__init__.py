@@ -1,0 +1,44 @@
+"""Bulk screening: all-vs-all chain-pair scoring over the serving engine.
+
+The model is siamese by construction (one shared-weight Geometric
+Transformer leg per chain, then an interaction stem + decoder), so an
+N-chain screen needs N encoder passes and N^2 cheap decodes — this
+package turns the serving stack into exactly that pipeline:
+
+* :mod:`~deepinteract_tpu.screening.library` — chain libraries from npz
+  dirs / packed memmaps / synthetic generators, plus pair enumeration;
+* :mod:`~deepinteract_tpu.screening.embcache` — content-addressed
+  embedding cache (in-memory LRU + optional npz spill);
+* :mod:`~deepinteract_tpu.screening.runner` — the pair scheduler over
+  the engine's split-phase AOT executables;
+* :mod:`~deepinteract_tpu.screening.manifest` — atomic progress ledger
+  with exactly-once preemption resume;
+* :mod:`~deepinteract_tpu.screening.scoring` — top-k contact summary
+  shared with ``cli/predict.py --top_k``.
+
+Entry points: ``python -m deepinteract_tpu.cli.screen`` (offline) and
+``POST /screen`` on the serving API (small synchronous screens).
+"""
+
+from deepinteract_tpu.screening.embcache import (  # noqa: F401
+    EmbeddingCache,
+    chain_hash,
+)
+from deepinteract_tpu.screening.library import (  # noqa: F401
+    ChainEntry,
+    ChainLibrary,
+    enumerate_pairs,
+)
+from deepinteract_tpu.screening.manifest import (  # noqa: F401
+    ScreenManifest,
+    pair_id,
+)
+from deepinteract_tpu.screening.runner import (  # noqa: F401
+    ScreenConfig,
+    ScreenResult,
+    ScreenRunner,
+)
+from deepinteract_tpu.screening.scoring import (  # noqa: F401
+    pair_summary,
+    rank_records,
+)

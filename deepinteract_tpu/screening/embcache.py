@@ -1,0 +1,182 @@
+"""Content-addressed per-chain embedding cache: encode once, decode many.
+
+A screened chain's encoder output is a pure function of its featurized
+arrays, the padded bucket, and the served weights — so an exact content
+hash is a sound cache key (the same argument ``serving/cache.py`` makes
+for whole-complex results, one level down the split forward). The cache
+holds the PADDED ``[bucket, C]`` float32 embedding plus the real length,
+so a hit feeds the decode batch without any re-layout.
+
+Two tiers:
+
+* **in-memory LRU** — bounded by entry count; the working set of an
+  all-vs-all screen is the library itself, so the default capacity covers
+  thousands of chains before eviction matters;
+* **optional on-disk npz spill** — entries evicted from memory are written
+  to ``spill_dir`` (atomic tmp+rename) and transparently reloaded on a
+  later get, so a library larger than memory still encodes each chain
+  once per screen, and a RESUMED screen (robustness/preemption.py) skips
+  re-encoding everything the killed run already paid for.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from deepinteract_tpu.data.io import GRAPH_KEYS
+from deepinteract_tpu.obs import metrics as obs_metrics
+
+_HITS = obs_metrics.counter(
+    "di_screen_embedding_cache_hits_total",
+    "Chain encodes skipped because the embedding was cached")
+_MISSES = obs_metrics.counter(
+    "di_screen_embedding_cache_misses_total",
+    "Embedding-cache lookups that required an encoder pass")
+_SPILLS = obs_metrics.counter(
+    "di_screen_embedding_cache_spills_total",
+    "Embeddings evicted from memory and written to the spill dir")
+
+
+def chain_hash(raw_chain: Dict[str, np.ndarray], extra: Iterable = ()) -> str:
+    """SHA-256 over one chain's model-visible arrays (the per-chain half
+    of ``serving/cache.content_hash``). ``extra`` mixes in everything else
+    the embedding depends on: bucket, weights identity, input_indep,
+    compute dtype."""
+    h = hashlib.sha256()
+    for key in GRAPH_KEYS:
+        a = np.ascontiguousarray(raw_chain[key])
+        h.update(f"{key}:{a.dtype.str}:{a.shape}".encode())
+        h.update(a.tobytes())
+    for item in extra:
+        h.update(repr(item).encode())
+    return h.hexdigest()
+
+
+class EmbeddingCache:
+    """Thread-safe LRU of padded chain embeddings with optional disk spill.
+
+    Values are ``(feats [bucket, C] float32, n real residues)``. Returned
+    arrays are read-only views — the decode path stacks copies anyway, and
+    a client mutating a cached embedding must fail loudly.
+    """
+
+    def __init__(self, capacity: int = 4096,
+                 spill_dir: Optional[str] = None):
+        self.capacity = int(capacity)
+        self.spill_dir = spill_dir
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        self._entries: "OrderedDict[str, Tuple[np.ndarray, int]]" = (
+            OrderedDict())
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._spills = 0
+        self._spill_hits = 0
+
+    # -- key ---------------------------------------------------------------
+
+    def _spill_path(self, key: str) -> str:
+        return os.path.join(self.spill_dir, f"emb_{key}.npz")
+
+    # -- access ------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[Tuple[np.ndarray, int]]:
+        with self._lock:
+            if self.capacity > 0 and key in self._entries:
+                self._entries.move_to_end(key)
+                self._hits += 1
+                _HITS.inc()
+                return self._entries[key]
+        if self.spill_dir:
+            path = self._spill_path(key)
+            if os.path.exists(path):
+                try:
+                    with np.load(path, allow_pickle=False) as z:
+                        feats = np.asarray(z["feats"], dtype=np.float32)
+                        n = int(z["n"])
+                except Exception:  # truncated spill (killed mid-write
+                    # before the atomic rename should make this
+                    # unreachable, but a corrupt file must read as a
+                    # miss, not kill the screen)
+                    with self._lock:
+                        self._misses += 1
+                    _MISSES.inc()
+                    return None
+                feats.setflags(write=False)
+                with self._lock:
+                    self._hits += 1
+                    self._spill_hits += 1
+                _HITS.inc()
+                self._admit(key, feats, n)
+                return feats, n
+        with self._lock:
+            self._misses += 1
+        _MISSES.inc()
+        return None
+
+    def put(self, key: str, feats: np.ndarray, n: int) -> None:
+        feats = np.asarray(feats, dtype=np.float32)
+        feats.setflags(write=False)
+        self._admit(key, feats, int(n))
+
+    def _admit(self, key: str, feats: np.ndarray, n: int) -> None:
+        evicted = []
+        with self._lock:
+            if self.capacity > 0:
+                self._entries[key] = (feats, n)
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.capacity:
+                    evicted.append(self._entries.popitem(last=False))
+            elif self.spill_dir:
+                evicted.append((key, (feats, n)))  # disk-only mode
+        for ekey, (efeats, en) in evicted:
+            self._spill(ekey, efeats, en)
+
+    def _spill(self, key: str, feats: np.ndarray, n: int) -> None:
+        if not self.spill_dir:
+            return
+        path = self._spill_path(key)
+        if os.path.exists(path):
+            return
+        tmp = path + ".tmp"
+        try:
+            # Through a file handle: np.savez given a PATH appends ".npz",
+            # which would break the tmp+rename atomicity dance.
+            with open(tmp, "wb") as fh:
+                np.savez(fh, feats=feats, n=np.int64(n))
+            os.replace(tmp, path)
+            with self._lock:
+                self._spills += 1
+            _SPILLS.inc()
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "capacity": self.capacity,
+                "size": len(self._entries),
+                "spill_dir": self.spill_dir,
+                "hits": self._hits,
+                "misses": self._misses,
+                "spills": self._spills,
+                "spill_hits": self._spill_hits,
+                "hit_rate": (self._hits / total) if total else 0.0,
+                "resident_bytes": sum(
+                    f.nbytes for f, _ in self._entries.values()),
+            }

@@ -1,0 +1,97 @@
+"""Screen job manifest: atomic progress checkpoints + exactly-once resume.
+
+A bulk screen is long-running batch work on preemptible capacity, so it
+gets the same discipline training got in PR 1: progress is flushed
+atomically (tmp + ``os.replace``) after every decode batch, and a
+SIGTERM'd screen rerun against the same manifest scores ONLY the
+remaining pairs — each pair is decoded exactly once across the runs
+(pinned by the chaos test in tests/test_screening.py).
+
+The manifest stores each completed pair's full score record, so the final
+ranked JSONL/CSV can always be regenerated from the manifest alone — a
+resumed run's output covers the whole screen, not just its own slice.
+The library signature guards against resuming over different data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+MANIFEST_VERSION = 1
+
+
+def pair_id(chain1: str, chain2: str) -> str:
+    return f"{chain1}|{chain2}"
+
+
+class ScreenManifest:
+    """Completed-pair ledger with atomic flushes."""
+
+    def __init__(self, path: str, signature: str, total_pairs: int,
+                 completed: Optional[Dict[str, Dict]] = None):
+        self.path = path
+        self.signature = signature
+        self.total_pairs = int(total_pairs)
+        self.completed: Dict[str, Dict] = dict(completed or {})
+        self._dirty = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def load_or_create(cls, path: str, signature: str,
+                       total_pairs: int) -> Tuple["ScreenManifest", bool]:
+        """(manifest, resumed). An existing manifest is resumed only when
+        its version AND library signature match; anything else starts
+        fresh (the stale file is kept aside as ``<path>.stale`` rather
+        than silently merged into a different screen)."""
+        if os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    data = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                data = None
+            if (data and data.get("version") == MANIFEST_VERSION
+                    and data.get("signature") == signature):
+                return cls(path, signature, total_pairs,
+                           completed=data.get("completed", {})), True
+            os.replace(path, path + ".stale")
+        return cls(path, signature, total_pairs), False
+
+    def mark_done(self, pid: str, record: Dict) -> None:
+        self.completed[pid] = record
+        self._dirty = True
+
+    def flush(self) -> None:
+        """Atomic write; called after every decode batch and on
+        preemption. A reader never sees a torn manifest."""
+        if not self._dirty and os.path.exists(self.path):
+            return
+        payload = {
+            "version": MANIFEST_VERSION,
+            "signature": self.signature,
+            "total_pairs": self.total_pairs,
+            "num_completed": len(self.completed),
+            "completed": self.completed,
+        }
+        tmp = self.path + ".tmp"
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, self.path)
+        self._dirty = False
+
+    # -- queries -----------------------------------------------------------
+
+    def remaining(self, pairs: Sequence[Tuple[str, str]]
+                  ) -> List[Tuple[str, str]]:
+        return [p for p in pairs if pair_id(*p) not in self.completed]
+
+    def records(self) -> List[Dict]:
+        return list(self.completed.values())
+
+    @property
+    def done(self) -> bool:
+        return len(self.completed) >= self.total_pairs

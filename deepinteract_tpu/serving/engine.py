@@ -161,8 +161,14 @@ class InferenceEngine:
         self._started = time.time()
 
         self.cache = ResultCache(cfg.result_cache_size)
+        self._seed = int(seed)
         self._init_weights(seed, ckpt_dir, metric_to_track)
         self._jit_forward = jax.jit(self._forward)
+        # Split-phase executables (bulk screening, deepinteract_tpu/
+        # screening): one encoder pass per CHAIN, one decode per pair over
+        # cached embeddings — registered in the same bucketed cache.
+        self._jit_encode = jax.jit(self._encode)
+        self._jit_decode = jax.jit(self._decode)
         if cfg.warmup_buckets:
             self.warmup(cfg.warmup_buckets)
         self.scheduler = MicroBatchScheduler(
@@ -324,23 +330,88 @@ class InferenceEngine:
         )
         return jax.nn.softmax(logits, axis=-1)[..., 1]
 
-    def _executable_for(self, key: Tuple[int, int, int, int, int], batch):
+    # -- split-phase forward (bulk screening) ------------------------------
+    #
+    # The model is siamese (one shared-weight encoder leg per chain), so an
+    # N-chain all-vs-all screen needs N encoder passes and N^2 cheap
+    # decodes — NOT N^2 full forwards. These two executables are the
+    # monolithic ``_forward`` split at ``DeepInteract.encode``/``decode``
+    # (models/model.py): composing them reproduces its probabilities
+    # exactly (parity-tested in tests/test_screening.py).
+
+    def _encode(self, params, batch_stats, graph):
+        # Python side effect: executes once per TRACE, never per call.
+        self.trace_count += 1
+        import jax.numpy as jnp
+
+        feats, _ = self.model.apply(
+            {"params": params, "batch_stats": batch_stats}, graph,
+            train=False, method="encode")
+        # Cached embeddings are dtype-stable float32 regardless of the
+        # compute policy (bf16 -> f32 is exact; decode re-casts to the
+        # policy dtype — models/model.py:decode).
+        return jnp.asarray(feats, dtype=jnp.float32)
+
+    def _decode(self, params, batch_stats, feats1, feats2, mask1, mask2):
+        self.trace_count += 1
+        import jax
+
+        logits = self.model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            feats1, feats2, mask1, mask2, train=False, method="decode")
+        return jax.nn.softmax(logits, axis=-1)[..., 1]
+
+    def chain_bucket(self, n: int) -> int:
+        """Padded bucket for a LONE chain under this engine's bucket
+        policy (the split-phase analog of :meth:`bucket_for`)."""
+        return self.bucket_for(n, n)[0]
+
+    def encode_executable(self, bucket: int, sig: Tuple, slots: int,
+                          graph_batch):
+        """AOT-compiled per-chain-bucket encoder over a ``[slots, bucket,
+        ...]`` stacked graph batch; cached under the same inventory as the
+        monolithic executables."""
+        key = ("enc", bucket, sig, slots)
+        return self._compiled(
+            key, f"enc:{bucket}/b{slots}/k{sig[0]}g{sig[1]}",
+            self._jit_encode, (self.params, self.batch_stats, graph_batch))
+
+    def decode_executable(self, b1: int, b2: int, slots: int, args: Tuple):
+        """AOT-compiled per-(bucket1, bucket2, batch) interaction-stem +
+        decoder over cached embeddings. ``args`` is (feats1, feats2,
+        mask1, mask2) at the padded bucket shapes."""
+        key = ("dec", b1, b2, slots)
+        return self._compiled(
+            key, f"dec:{b1}x{b2}/b{slots}", self._jit_decode,
+            (self.params, self.batch_stats) + tuple(args))
+
+    def weights_signature(self) -> str:
+        """Identity of the served weights — part of the embedding-cache
+        key (an embedding is a function of chain features AND weights)."""
+        return self.restored_from or f"init-seed{self._seed}"
+
+    def _compiled(self, key: Tuple, label: str, jit_fn, args):
         """Warm path: dict hit, zero traces. Cold path: one explicit
-        lower+compile, recorded in the per-bucket inventory."""
+        lower+compile, recorded in the per-bucket inventory. Shared by the
+        monolithic forward and the split-phase encode/decode executables
+        (one cache, one lock, one compile counter)."""
         with self._exec_lock:
             cached = self._executables.get(key)
             if cached is not None:
                 return cached
             t0 = time.perf_counter()
-            compiled = self._jit_forward.lower(
-                self.params, self.batch_stats, batch.graph1, batch.graph2
-            ).compile()
+            compiled = jit_fn.lower(*args).compile()
             self._executables[key] = compiled
             elapsed = time.perf_counter() - t0
-            self._compile_seconds[self._key_label(key)] = elapsed
+            self._compile_seconds[label] = elapsed
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(elapsed)
             return compiled
+
+    def _executable_for(self, key: Tuple[int, int, int, int, int], batch):
+        return self._compiled(
+            key, self._key_label(key), self._jit_forward,
+            (self.params, self.batch_stats, batch.graph1, batch.graph2))
 
     @staticmethod
     def _key_label(key: Tuple) -> str:

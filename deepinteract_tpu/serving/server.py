@@ -13,6 +13,16 @@ Endpoints:
   "right_pdb": ...}`` featurized server-side via ``pipeline/pair.py``.
   Response: ``{"complex_name", "n1", "n2", "bucket", "cached",
   "coalesced", "latency_ms", "contact_probs": [[...]]}``.
+* ``POST /screen`` — small SYNCHRONOUS bulk screen (docking funnel):
+  JSON ``{"npz_paths": [...complex npz...], "top_k": 10, "include_self":
+  false, "max_pairs": 0, "query": ["name:g1", ...]}``. The listed
+  complexes are split into chains, every pair is scored through the
+  split-phase path (N encoder passes + N^2 micro-batched decodes over
+  the server's shared embedding cache — ``deepinteract_tpu.screening``),
+  and the ranked records come back in the response. Screens above
+  ``screen_max_pairs`` are refused with 400 — the offline
+  ``cli/screen.py`` (manifest + preemption resume) is the tool for
+  those.
 * ``GET /healthz`` — liveness + draining flag.
 * ``GET /stats`` — queue depth, per-bucket compile inventory, result-cache
   hit rate, and request-latency percentiles.
@@ -136,11 +146,19 @@ class ServingServer:
     """Engine + ThreadingHTTPServer + cooperative drain."""
 
     def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
-                 port: int = 8008, request_timeout_s: float = 120.0):
+                 port: int = 8008, request_timeout_s: float = 120.0,
+                 screen_max_pairs: int = 512):
         self.engine = engine
         self.latency = _LatencyTracker()
         self._draining = threading.Event()
         self.request_timeout_s = request_timeout_s
+        self.screen_max_pairs = int(screen_max_pairs)
+        # Screens share one embedding cache across requests (a library
+        # chain re-screened later skips its encoder pass) and serialize
+        # on one lock: each screen is many device dispatches, and two
+        # interleaved screens would just thrash the device queue.
+        self._screen_cache = None
+        self._screen_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -161,7 +179,8 @@ class ServingServer:
                 # path — unknown client paths must not mint unbounded
                 # label values in the registry.
                 endpoint = self.path if self.path in (
-                    "/predict", "/healthz", "/stats", "/metrics") else "other"
+                    "/predict", "/screen", "/healthz", "/stats",
+                    "/metrics") else "other"
                 _REQUESTS.inc(endpoint=endpoint, status=str(code))
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
@@ -189,11 +208,14 @@ class ServingServer:
                     self._send_json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802 - stdlib name
-                if self.path != "/predict":
+                if self.path not in ("/predict", "/screen"):
                     self._send_json(404, {"error": f"no route {self.path}"})
                     return
                 if server._draining.is_set():
                     self._send_json(503, {"error": "server is draining"})
+                    return
+                if self.path == "/screen":
+                    self._do_screen()
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -230,6 +252,29 @@ class ServingServer:
                     "contact_probs": np.asarray(
                         result["probs"], dtype=np.float64).tolist(),
                 })
+
+            def _do_screen(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length).decode())
+                    if not isinstance(payload, dict):
+                        raise ValueError("screen body must be a JSON object")
+                except Exception as exc:  # noqa: BLE001 - client error
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                t0 = time.monotonic()
+                try:
+                    out = server.run_screen(payload)
+                except (ValueError, KeyError, FileNotFoundError,
+                        OSError) as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                except Exception as exc:  # noqa: BLE001 - surfaced
+                    logger.exception("screen failed")
+                    self._send_json(500, {"error": str(exc)})
+                    return
+                out["latency_ms"] = (time.monotonic() - t0) * 1e3
+                self._send_json(200, out)
 
         self.httpd = _QuietThreadingHTTPServer((host, port), Handler)
         self._serve_thread: Optional[threading.Thread] = None
@@ -275,7 +320,8 @@ class ServingServer:
             self.serve_background()
             host, port = self.address
             logger.info("serving on http://%s:%d (POST /predict, "
-                        "GET /healthz, GET /stats, GET /metrics)", host, port)
+                        "POST /screen, GET /healthz, GET /stats, "
+                        "GET /metrics)", host, port)
             while not guard.requested:
                 time.sleep(poll_seconds)
             logger.warning("drain requested (%s): refusing new requests, "
@@ -287,6 +333,53 @@ class ServingServer:
             if own_guard:
                 guard.__exit__(None, None, None)
         return 0
+
+    # -- screening ---------------------------------------------------------
+
+    def run_screen(self, payload: Dict) -> Dict:
+        """Synchronous small screen for ``POST /screen`` (see module
+        docstring). Raises ValueError/KeyError/OSError for client
+        mistakes (mapped to 400 by the handler)."""
+        from deepinteract_tpu.screening import (
+            ChainLibrary,
+            EmbeddingCache,
+            ScreenConfig,
+            ScreenRunner,
+            enumerate_pairs,
+        )
+
+        npz_paths = payload.get("npz_paths")
+        if not npz_paths or not isinstance(npz_paths, list):
+            raise ValueError("screen body needs 'npz_paths': a non-empty "
+                             "list of complex .npz paths")
+        library = ChainLibrary.from_complex_files(
+            [str(p) for p in npz_paths])
+        pairs = enumerate_pairs(
+            library,
+            queries=payload.get("query"),
+            include_self=bool(payload.get("include_self", False)),
+            max_pairs=int(payload.get("max_pairs", 0)))
+        if len(pairs) > self.screen_max_pairs:
+            raise ValueError(
+                f"screen of {len(pairs)} pairs exceeds the synchronous "
+                f"limit ({self.screen_max_pairs}); run cli/screen.py for "
+                "large libraries (manifest + preemption resume)")
+        with self._screen_lock:
+            if self._screen_cache is None:
+                self._screen_cache = EmbeddingCache()
+            runner = ScreenRunner(
+                self.engine, cache=self._screen_cache,
+                cfg=ScreenConfig(
+                    top_k=int(payload.get("top_k", 10)),
+                    decode_batch=self.engine.cfg.max_batch,
+                    encode_batch=self.engine.cfg.max_batch))
+            result = runner.screen(library, pairs)
+        return {
+            "chains": result.chains,
+            "pairs": result.pairs_total,
+            "ranked": result.records,
+            **result.summary(),
+        }
 
     # -- observability -----------------------------------------------------
 

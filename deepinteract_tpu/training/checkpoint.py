@@ -17,6 +17,22 @@ from typing import Any, Optional
 import orbax.checkpoint as ocp
 
 
+def _partial_restore_args(target: Any):
+    """Restore-args for a target tree that holds a SUBSET of the saved
+    keys (fine-tune warm starts, serving's params/batch_stats-only
+    template). Current orbax spells this ``PyTreeRestore(target,
+    partial_restore=True)``; releases before 0.11 reject that kwarg but
+    express the same semantics through an empty ``transforms`` dict
+    (every target leaf falls back to the same-named checkpoint entry,
+    checkpoint keys absent from the target are dropped)."""
+    try:
+        return ocp.args.PyTreeRestore(target, partial_restore=True)
+    except TypeError:  # orbax < 0.11: partial_restore kwarg not yet added
+        return ocp.args.PyTreeRestore(
+            item=target, transforms={},
+            restore_args=ocp.checkpoint_utils.construct_restore_args(target))
+
+
 def metric_mode(metric_name: str) -> str:
     """'min' iff the tracked metric name contains 'ce' (lit_model_train.py:
     139-143); everything else (prec/recall/auroc...) is maximized."""
@@ -121,9 +137,7 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.cfg.directory} ({which})")
         if partial:
-            return mgr.restore(
-                step, args=ocp.args.PyTreeRestore(target, partial_restore=True)
-            )
+            return mgr.restore(step, args=_partial_restore_args(target))
         return mgr.restore(step, args=ocp.args.StandardRestore(target))
 
     def close(self) -> None:

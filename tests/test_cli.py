@@ -85,7 +85,8 @@ def test_train_then_test_then_predict(dataset_root, tmp_path):
     out_dir = str(tmp_path / "pred")
     rc = predict_cli.main(
         TINY_MODEL_ARGS
-        + ["--input_npz", npz, "--ckpt_name", ckpt_dir, "--output_dir", out_dir]
+        + ["--input_npz", npz, "--ckpt_name", ckpt_dir, "--output_dir", out_dir,
+           "--top_k", "5"]
     )
     assert rc == 0
     probs = np.load(os.path.join(out_dir, "contact_prob_map.npy"))
@@ -93,3 +94,18 @@ def test_train_then_test_then_predict(dataset_root, tmp_path):
     assert np.all((probs >= 0) & (probs <= 1))
     assert os.path.exists(os.path.join(out_dir, "graph1_node_feats.npy"))
     assert np.load(os.path.join(out_dir, "graph1_node_feats.npy")).shape == (22, 16)
+
+    # --top_k rides the same pair_summary helper screening ranks with:
+    # the artifact must agree with an independent recomputation from the map.
+    import json
+
+    from deepinteract_tpu.screening.scoring import pair_summary
+
+    summary = json.load(open(os.path.join(out_dir, "top_contacts.json")))
+    assert summary["top_k"] == 5
+    assert len(summary["top_contacts"]) == 5
+    expected = pair_summary(probs, 5)
+    assert summary["score"] == pytest.approx(expected["score"], rel=1e-6)
+    top = summary["top_contacts"][0]
+    assert probs[top["i"], top["j"]] == pytest.approx(summary["max_prob"],
+                                                     rel=1e-6)

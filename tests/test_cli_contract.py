@@ -1,0 +1,115 @@
+"""Fast-tier wiring of tools/check_cli_contract.py: every CLI entry point
+whose final stdout line is a machine contract stays parseable.
+
+Coverage map (the satellite's screen/tune/bench triple):
+
+* **bench** — validated here against bench.py's real headline builder
+  (same discipline as tests/test_bench_contract.py) plus a key-set sync
+  check against the dedicated bench validator;
+* **tune** — validated against a REAL ``cli.tune --dry_run`` capture (the
+  deterministic CPU cost model exercises the whole pipeline);
+* **screen** — validated against the real CLI in
+  tests/test_screening.py::test_cli_screen_end_to_end_and_contract (the
+  12-chain e2e run); the malformed-line cases live here.
+"""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.check_bench_contract import REQUIRED_KEYS  # noqa: E402
+from tools.check_cli_contract import (  # noqa: E402
+    CONTRACTS,
+    check_cli_contract_text,
+    final_json_line,
+)
+
+GOOD_SCREEN = json.dumps({
+    "metric": "screen_pairs_per_sec", "value": 12.5, "unit": "pairs/s",
+    "pairs_total": 66, "pairs_scored": 66, "encode_reuse_ratio": 11.0,
+    "emb_cache_hit_rate": 0.0, "ranked_out": "/tmp/s.jsonl",
+    "manifest": "/tmp/s.manifest.json"})
+
+
+def test_final_json_line_discipline():
+    assert final_json_line(f"log noise\n{GOOD_SCREEN}\n")["value"] == 12.5
+    with pytest.raises(ValueError, match="empty"):
+        final_json_line("\n\n")
+    with pytest.raises(ValueError, match="not JSON"):
+        final_json_line(GOOD_SCREEN + "\nDETAIL {}")
+    with pytest.raises(ValueError, match="not an object"):
+        final_json_line("[1, 2]")
+
+
+def test_screen_contract_keys_and_types():
+    rec = check_cli_contract_text(GOOD_SCREEN, "screen")
+    assert rec["pairs_total"] == 66
+    with pytest.raises(ValueError, match="missing keys"):
+        check_cli_contract_text(
+            json.dumps({"metric": "m", "value": 1.0}), "screen")
+    bad = json.loads(GOOD_SCREEN)
+    bad["pairs_total"] = "many"
+    with pytest.raises(ValueError, match="must be a number"):
+        check_cli_contract_text(json.dumps(bad), "screen")
+    with pytest.raises(ValueError, match="unknown contract kind"):
+        check_cli_contract_text(GOOD_SCREEN, "nope")
+
+
+def test_bench_kind_stays_in_sync_with_dedicated_validator():
+    """The generalized tool's bench spec must cover exactly the keys the
+    dedicated bench validator enforces — a drift would let one pass what
+    the other rejects."""
+    assert tuple(CONTRACTS["bench"]["required"]) == tuple(REQUIRED_KEYS)
+
+
+def test_bench_headline_builder_passes_bench_kind():
+    import bench
+
+    line = json.dumps(bench._build_headline(
+        {"buckets": {"b1_p128": {"train_scan_complexes_per_sec": 33.0,
+                                 "batch": 1,
+                                 "train_scan_ms_per_step": 30.0}},
+         "interaction_stem": "factorized", "compute_dtype": "float32"},
+        scan_k=8))
+    rec = check_cli_contract_text(f"noise\n{line}", "bench")
+    assert rec["value"] == 33.0
+
+
+def test_predict_topk_contract_shape():
+    line = json.dumps({"metric": "pair_score_topk_mean", "value": 0.31,
+                       "unit": "probability", "top_k": 10,
+                       "max_prob": 0.9, "n1": 20, "n2": 16,
+                       "top_contacts_out": "x/top_contacts.json",
+                       "contact_map_out": "x/contact_prob_map.npy"})
+    assert check_cli_contract_text(line, "predict_topk")["top_k"] == 10
+
+
+def test_tune_dry_run_capture_passes_tune_kind(tmp_path, capsys):
+    """The REAL tune CLI in --dry_run mode (deterministic cost model, no
+    device measurement) ends its capture with a line the tune contract
+    accepts."""
+    from deepinteract_tpu.cli.tune import main
+
+    rc = main(["--dry_run", "--tune_buckets", "1x64", "--max_trials", "4",
+               "--ckpt_dir", str(tmp_path)])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "tune")
+    assert rec["dry_run"] is True
+    assert "b1_p64" in rec["buckets"] or rec["buckets"]
+
+
+def test_cli_main_entry(tmp_path, capsys):
+    from tools.check_cli_contract import main
+
+    cap = tmp_path / "cap.log"
+    cap.write_text(f"noise\n{GOOD_SCREEN}\n")
+    assert main(["screen", str(cap)]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["contract_ok"] is True
+    cap.write_text("no json here\n")
+    assert main(["screen", str(cap)]) == 1

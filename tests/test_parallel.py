@@ -10,7 +10,13 @@ from deepinteract_tpu.data.synthetic import random_complex
 from deepinteract_tpu.models.decoder import DecoderConfig
 from deepinteract_tpu.models.geometric_transformer import GTConfig
 from deepinteract_tpu.models.model import DeepInteract, ModelConfig
-from deepinteract_tpu.parallel import make_mesh, make_sharded_train_step, replicate, shard_batch
+from deepinteract_tpu.parallel import (
+    make_mesh,
+    make_sharded_train_step,
+    mesh_context,
+    replicate,
+    shard_batch,
+)
 from deepinteract_tpu.training import create_train_state, train_step
 from deepinteract_tpu.training.optim import OptimConfig
 
@@ -48,7 +54,7 @@ def test_sharded_step_matches_single_device(rng):
 
     model_sharded, _ = tiny(4, np.random.default_rng(0), shard_pair=True)
     mesh = make_mesh(num_data=4, num_pair=2)
-    with jax.set_mesh(mesh):
+    with mesh_context(mesh):
         state2 = create_train_state(model_sharded, batch, seed=1,
                                     optim_cfg=OptimConfig(steps_per_epoch=4, num_epochs=2))
         state2 = replicate(state2, mesh)
@@ -59,12 +65,13 @@ def test_sharded_step_matches_single_device(rng):
     np.testing.assert_allclose(float(ref_metrics["loss"]), float(metrics["loss"]), rtol=1e-5)
     ref_leaves = jax.tree_util.tree_leaves(ref_state.params)
     new_leaves = jax.tree_util.tree_leaves(new_state.params)
-    # Adam normalizes by sqrt(v): bit-level reduction-order differences in
-    # the gradients can move a parameter by O(lr) regardless of magnitude,
-    # so compare post-step params with a tolerance well below lr=1e-3 * steps
-    # but above float noise.
+    # Adam normalizes by sqrt(v): on the first (bias-corrected) step the
+    # update is +-lr regardless of gradient magnitude, so a reduction-order
+    # sign flip on a near-zero gradient legitimately separates the two
+    # params by up to 2*lr. Bound just above that worst case; a real wiring
+    # bug (wrong shard, stale params) moves many elements, not a few.
     for a, b in zip(ref_leaves, new_leaves):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2.1e-3)
 
 
 @pytest.mark.slow
@@ -90,7 +97,7 @@ def test_sharded_multi_step(rng):
         for _ in range(2)
     ]
     mesh = make_mesh(num_data=4, num_pair=2)
-    with jax.set_mesh(mesh):
+    with mesh_context(mesh):
         state = create_train_state(model, batches[0], seed=1,
                                    optim_cfg=OptimConfig(steps_per_epoch=2, num_epochs=2))
         state = replicate(state, mesh)
@@ -161,7 +168,7 @@ def test_trainer_with_mesh_donation_and_scanned_eval(rng):
     s0, hist0 = single.fit(s0, data, val_data=data[:3])
 
     mesh = make_mesh(num_data=4, num_pair=1)
-    with jax.set_mesh(mesh):
+    with mesh_context(mesh):
         sharded = Trainer(model, cfg, optim, mesh=mesh, log_fn=lambda s: None)
         s1 = sharded.init_state(data[0])
         s1, hist1 = sharded.fit(s1, data, val_data=data[:3])
@@ -190,7 +197,7 @@ def test_swa_finalization_on_mesh(rng):
     cfg = LoopConfig(num_epochs=2, log_every=0, swa=True, swa_epoch_start=0.0)
     optim = OptimConfig(steps_per_epoch=2, num_epochs=2)
     mesh = make_mesh(num_data=4, num_pair=1)
-    with jax.set_mesh(mesh):
+    with mesh_context(mesh):
         trainer = Trainer(model, cfg, optim, mesh=mesh, log_fn=lambda s: None)
         state = trainer.init_state(data[0])
         state, hist = trainer.fit(state, data)

@@ -1,0 +1,466 @@
+"""Bulk-screening tests: split-phase parity, embedding cache, manifest
+resume (incl. the preemption chaos test), pair scheduling, the screen CLI
+end-to-end on a 12-chain synthetic library, and the HTTP /screen route.
+
+All fast-tier on the tiny model (the suite pins the screening MACHINERY,
+not the architecture). The module-scoped engine pays the split-phase
+compiles once; parity tests run model-level (no engine) so they stay
+independent of the serving stack.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.data.graph import stack_complexes
+from deepinteract_tpu.data.io import save_complex_npz
+from deepinteract_tpu.data.synthetic import random_complex
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import DeepInteract, ModelConfig
+from deepinteract_tpu.models.vision import DeepLabConfig
+from deepinteract_tpu.robustness.preemption import PreemptionGuard
+from deepinteract_tpu.screening import (
+    ChainLibrary,
+    EmbeddingCache,
+    ScreenConfig,
+    ScreenManifest,
+    ScreenRunner,
+    chain_hash,
+    enumerate_pairs,
+    pair_id,
+    pair_summary,
+)
+from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+
+KNN, GEO = 6, 2
+
+
+def tiny_model_cfg(**overrides):
+    return ModelConfig(
+        gnn=GTConfig(num_layers=1, hidden=16, num_heads=2, shared_embed=8,
+                     dropout_rate=0.0),
+        decoder=DecoderConfig(num_chunks=1, num_channels=8,
+                              dilation_cycle=(1,)),
+        **overrides,
+    )
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InferenceEngine(
+        tiny_model_cfg(),
+        cfg=EngineConfig(max_batch=8, result_cache_size=16))
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def library():
+    # 8 chains keeps the module's screen costs inside the fast tier; the
+    # ISSUE-6 12-chain acceptance run lives in the CLI e2e test below
+    # (which builds its own 12-chain library through --synthetic_chains).
+    return ChainLibrary.synthetic(8, 20, 40, seed=3, knn=KNN,
+                                  geo_nbrhd_size=GEO)
+
+
+# ---------------------------------------------------------------------------
+# Split-phase parity: decode(encode, encode) == monolithic __call__
+# ---------------------------------------------------------------------------
+
+
+def _init_and_compare(cfg, atol=0.0, rng_seed=0):
+    """Monolithic forward vs encode+decode through ``method=`` applies,
+    on a padded+masked batch: the tentpole's parity guarantee. Params are
+    fabricated from abstract shapes (tests/test_stem.py) — parity runs
+    the SAME variables through both forms, so ``init``'s compile cost
+    buys nothing here."""
+    import jax
+
+    from tests.test_stem import _fab_variables
+
+    model = DeepInteract(cfg)
+    cx = stack_complexes([
+        random_complex(20, 16, np.random.default_rng(rng_seed), n_pad1=32,
+                       n_pad2=32, knn=KNN, geo_nbrhd_size=GEO),
+        random_complex(26, 22, np.random.default_rng(rng_seed + 1),
+                       n_pad1=32, n_pad2=32, knn=KNN, geo_nbrhd_size=GEO),
+    ])
+    variables = _fab_variables(
+        model,
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        cx.graph1, cx.graph2, train=False)
+    mono = np.asarray(model.apply(variables, cx.graph1, cx.graph2,
+                                  train=False))
+    f1, _ = model.apply(variables, cx.graph1, train=False, method="encode")
+    f2, _ = model.apply(variables, cx.graph2, train=False, method="encode")
+    # Embeddings cross the split as float32 host arrays (the embedding
+    # cache's storage dtype) — exactly what the screening path feeds back.
+    split = np.asarray(model.apply(
+        variables, np.asarray(f1, np.float32), np.asarray(f2, np.float32),
+        cx.graph1.node_mask, cx.graph2.node_mask, train=False,
+        method="decode"))
+    if atol == 0.0:
+        np.testing.assert_array_equal(split, mono)
+    else:
+        np.testing.assert_allclose(split, mono, atol=atol)
+
+
+def test_split_parity_dilated_byte_exact():
+    _init_and_compare(tiny_model_cfg())
+
+
+def test_split_parity_materialized_stem():
+    _init_and_compare(tiny_model_cfg(interaction_stem="materialized"))
+
+
+def test_split_parity_deeplab():
+    cfg = tiny_model_cfg(
+        interact_module_type="deeplab",
+        deeplab=DeepLabConfig(stem_channels=4, stage_channels=(4, 8, 8, 8),
+                              stage_blocks=(1, 1, 1, 1), aspp_rates=(2, 4, 6),
+                              decoder_channels=8, high_res_channels=4,
+                              dropout_rate=0.0))
+    _init_and_compare(cfg)
+
+
+def test_split_parity_bf16_within_tolerance():
+    # bf16 encoder outputs round-trip through the cache's float32 storage
+    # losslessly (bf16 -> f32 is exact), so even under the end-to-end
+    # bf16 policy the split forward matches the monolithic one exactly;
+    # the tolerance guards against future policy changes at the seam.
+    _init_and_compare(tiny_model_cfg(compute_dtype="bfloat16"), atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# Embedding cache
+# ---------------------------------------------------------------------------
+
+
+def test_chain_hash_sensitivity(library):
+    a, b = library.chains[0], library.chains[1]
+    assert chain_hash(a.raw) == chain_hash(a.raw)
+    assert chain_hash(a.raw) != chain_hash(b.raw)
+    tweaked = dict(a.raw, node_feats=a.raw["node_feats"] + 1.0)
+    assert chain_hash(a.raw) != chain_hash(tweaked)
+    assert chain_hash(a.raw, extra=(64,)) != chain_hash(a.raw, extra=(128,))
+
+
+def test_embedding_cache_lru_and_stats():
+    cache = EmbeddingCache(capacity=2)
+    f = np.zeros((8, 4), np.float32)
+    cache.put("a", f, 5)
+    cache.put("b", f + 1, 6)
+    got = cache.get("a")  # refresh: b becomes LRU
+    assert got is not None and got[1] == 5
+    cache.put("c", f + 2, 7)
+    assert cache.get("b") is None  # evicted, no spill dir
+    s = cache.stats()
+    assert s["size"] == 2 and s["hits"] == 1 and s["misses"] == 1
+    # Cached arrays are read-only.
+    with pytest.raises(ValueError):
+        cache.get("a")[0][0, 0] = 9.0
+
+
+def test_embedding_cache_spills_and_reloads(tmp_path):
+    spill = str(tmp_path / "spill")
+    cache = EmbeddingCache(capacity=1, spill_dir=spill)
+    f1 = np.arange(12, dtype=np.float32).reshape(4, 3)
+    cache.put("k1", f1, 4)
+    cache.put("k2", f1 + 10, 3)  # evicts k1 -> disk
+    assert cache.stats()["spills"] == 1
+    got = cache.get("k1")  # transparent reload from disk
+    assert got is not None
+    np.testing.assert_array_equal(got[0], f1)
+    assert got[1] == 4
+    assert cache.stats()["spill_hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Library + pair enumeration + scoring
+# ---------------------------------------------------------------------------
+
+
+def test_enumerate_pairs_modes(library):
+    ids = library.ids()
+    pairs = enumerate_pairs(library)
+    assert len(pairs) == 8 * 7 // 2  # all-vs-all, unordered
+    assert len({frozenset(p) for p in pairs}) == len(pairs)
+    with_self = enumerate_pairs(library, include_self=True)
+    assert len(with_self) == len(pairs) + 8
+    q = enumerate_pairs(library, queries=[ids[0], ids[1]])
+    # Each query against the library, unordered pairs deduped.
+    assert len(q) == 7 + 6
+    assert all(ids[0] in p or ids[1] in p for p in q)
+    assert enumerate_pairs(library, max_pairs=7) == pairs[:7]
+    with pytest.raises(KeyError):
+        enumerate_pairs(library, queries=["nope"])
+
+
+def test_library_signature_tracks_content(library):
+    lib2 = ChainLibrary.synthetic(8, 20, 40, seed=3, knn=KNN,
+                                  geo_nbrhd_size=GEO)
+    assert library.signature() == lib2.signature()
+    lib3 = ChainLibrary.synthetic(8, 20, 40, seed=4, knn=KNN,
+                                  geo_nbrhd_size=GEO)
+    assert library.signature() != lib3.signature()
+
+
+def test_library_from_npz_dir_and_files(tmp_path, library):
+    for i in range(2):
+        raw = {"graph1": library.chains[2 * i].raw,
+               "graph2": library.chains[2 * i + 1].raw}
+        save_complex_npz(str(tmp_path / f"cx{i}.npz"), raw["graph1"],
+                         raw["graph2"], np.zeros((0, 3), np.int32),
+                         f"cx{i}")
+    lib = ChainLibrary.from_npz_dir(str(tmp_path))
+    assert sorted(lib.ids()) == ["cx0:g1", "cx0:g2", "cx1:g1", "cx1:g2"]
+    assert lib["cx0:g1"].n == library.chains[0].n
+
+
+def test_pair_summary_topk_and_transpose_invariance():
+    probs = np.zeros((4, 5), np.float32)
+    probs[1, 2] = 0.9
+    probs[3, 0] = 0.7
+    probs[0, 4] = 0.5
+    s = pair_summary(probs, top_k=2)
+    assert s["top_contacts"][0] == {"i": 1, "j": 2, "p": 0.9}
+    assert s["top_contacts"][1]["p"] == pytest.approx(0.7)
+    assert s["score"] == pytest.approx(0.8)
+    assert s["max_prob"] == pytest.approx(0.9)
+    st = pair_summary(probs.T, top_k=2)
+    assert st["score"] == pytest.approx(s["score"])  # ranking key symmetric
+    assert pair_summary(probs, top_k=999)["top_k"] == 20  # clamped
+
+
+# ---------------------------------------------------------------------------
+# Manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_resume_and_stale(tmp_path):
+    path = str(tmp_path / "m.json")
+    m, resumed = ScreenManifest.load_or_create(path, "sigA", 3)
+    assert not resumed
+    m.mark_done("a|b", {"pair_id": "a|b", "score": 0.5})
+    m.flush()
+    m2, resumed = ScreenManifest.load_or_create(path, "sigA", 3)
+    assert resumed and "a|b" in m2.completed
+    assert m2.remaining([("a", "b"), ("a", "c")]) == [("a", "c")]
+    # A different library signature must NOT resume; the old file is
+    # preserved aside, not merged.
+    m3, resumed = ScreenManifest.load_or_create(path, "sigB", 3)
+    assert not resumed and not m3.completed
+    assert os.path.exists(path + ".stale")
+
+
+# ---------------------------------------------------------------------------
+# Runner over the shared engine
+# ---------------------------------------------------------------------------
+
+
+def test_screen_matches_monolithic_predict(engine, library):
+    """The acceptance parity: the split-phase screen's scores equal the
+    monolithic predict path's scores for the same chains and weights."""
+    pairs = enumerate_pairs(library, max_pairs=6)
+    runner = ScreenRunner(engine, cache=EmbeddingCache(),
+                          cfg=ScreenConfig(top_k=5, decode_batch=4))
+    result = runner.screen(library, pairs)
+    assert result.pairs_scored == 6
+    by_id = {r["pair_id"]: r for r in result.records}
+    for c1, c2 in pairs[:3]:
+        raw = {"graph1": library[c1].raw, "graph2": library[c2].raw,
+               "examples": np.zeros((0, 3), np.int32)}
+        mono = pair_summary(engine.predict(raw)["probs"], 5)
+        rec = by_id[pair_id(c1, c2)]
+        assert rec["score"] == pytest.approx(mono["score"], abs=1e-5)
+        assert rec["max_prob"] == pytest.approx(mono["max_prob"], abs=1e-5)
+
+
+def test_screen_encodes_each_chain_once_and_warm_repeat(engine, library):
+    pairs = enumerate_pairs(library)
+    cache = EmbeddingCache()
+    runner = ScreenRunner(engine, cache=cache,
+                          cfg=ScreenConfig(top_k=5, decode_batch=4))
+    r1 = runner.screen(library, pairs)
+    assert r1.pairs_scored == len(pairs) == 28
+    assert r1.encodes_executed == 8  # one encoder pass per chain
+    assert r1.encode_reuse_ratio == pytest.approx(2 * 28 / 8)
+    # Ranked output is sorted descending.
+    scores = [r["score"] for r in r1.records]
+    assert scores == sorted(scores, reverse=True)
+
+    traces_before = engine.stats()["trace_count"]
+    r2 = runner.screen(library, pairs)
+    # Warm repeat: zero encoder passes (cache hits) and ZERO new traces.
+    assert r2.encodes_executed == 0
+    assert r2.encode_cache_hits == 8
+    assert engine.stats()["trace_count"] == traces_before
+    for a, b in zip(r1.records, r2.records):
+        assert a["pair_id"] == b["pair_id"]
+        assert a["score"] == pytest.approx(b["score"], abs=1e-6)
+
+
+def test_chaos_preempted_screen_resumes_exactly_once(engine, library,
+                                                     tmp_path):
+    """SIGTERM a screen mid-run (guard request at a decode-batch
+    boundary, the PR-1 discipline), then rerun: the remaining pairs are
+    scored exactly once and the union covers the whole screen."""
+    pairs = enumerate_pairs(library)
+    manifest_path = str(tmp_path / "chaos_manifest.json")
+    sig = library.signature()
+    guard = PreemptionGuard(log=lambda m: None)
+
+    m1, resumed = ScreenManifest.load_or_create(manifest_path, sig,
+                                                len(pairs))
+    assert not resumed
+    runner = ScreenRunner(engine, cache=EmbeddingCache(),
+                          cfg=ScreenConfig(top_k=5, decode_batch=4))
+    r1 = runner.screen(
+        library, pairs, manifest=m1, guard=guard,
+        after_batch=lambda n: guard.request("chaos SIGTERM") if n == 3
+        else None)
+    assert r1.preempted
+    assert 0 < r1.pairs_scored < len(pairs)
+    first_run_ids = set(m1.completed)
+    assert len(first_run_ids) == r1.pairs_scored  # durable before exit
+
+    # Rerun the same screen against the on-disk manifest (fresh objects —
+    # a new process).
+    m2, resumed = ScreenManifest.load_or_create(manifest_path, sig,
+                                                len(pairs))
+    assert resumed and set(m2.completed) == first_run_ids
+    runner2 = ScreenRunner(engine, cache=EmbeddingCache(),
+                           cfg=ScreenConfig(top_k=5, decode_batch=4))
+    r2 = runner2.screen(library, pairs, manifest=m2,
+                        guard=PreemptionGuard(log=lambda m: None))
+    assert not r2.preempted
+    # Exactly once: the two runs partition the pair set.
+    assert r1.pairs_scored + r2.pairs_scored == len(pairs)
+    assert r2.pairs_resumed == r1.pairs_scored
+    assert set(m2.completed) == {pair_id(*p) for p in pairs}
+    # The resumed run's ranked output covers the WHOLE screen.
+    assert len(r2.records) == len(pairs)
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end (12-chain synthetic library) + contract line
+# ---------------------------------------------------------------------------
+
+
+TINY_CLI_ARGS = [
+    "--num_gnn_layers", "1", "--num_gnn_hidden_channels", "16",
+    "--num_gnn_attention_heads", "2", "--num_interact_layers", "1",
+    "--num_interact_hidden_channels", "8", "--dropout_rate", "0.0",
+]
+
+
+def test_cli_screen_end_to_end_and_contract(tmp_path, capsys):
+    """ISSUE-6 acceptance: a >=12-chain synthetic screen through
+    cli/screen.py produces a correctly ranked output, and the final
+    stdout line honors the machine-readable contract."""
+    import pathlib
+    import sys as _sys
+
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from tools.check_cli_contract import check_cli_contract_text
+
+    from deepinteract_tpu.cli.screen import main
+
+    out = str(tmp_path / "screen" / "run1")
+    rc = main(TINY_CLI_ARGS + [
+        "--synthetic_chains", "12", "--synthetic_len", "20,40",
+        "--screen_batch", "4", "--top_k", "5", "--out", out])
+    assert rc == 0
+    captured = capsys.readouterr().out
+    record = check_cli_contract_text(captured, "screen")
+    assert record["pairs_total"] == 66 and record["pairs_scored"] == 66
+    assert record["chains"] == 12
+    assert record["encode_reuse_ratio"] == pytest.approx(11.0)
+    assert not record["preempted"]
+
+    with open(record["ranked_out"]) as fh:
+        rows = [json.loads(ln) for ln in fh]
+    assert len(rows) == 66
+    assert [r["rank"] for r in rows] == list(range(1, 67))
+    scores = [r["score"] for r in rows]
+    assert scores == sorted(scores, reverse=True)
+    assert rows[0]["pair_id"] == record["top_pair"]["pair_id"]
+    assert os.path.exists(record["csv_out"])
+
+    # Rerun: full resume, zero device work, same ranking.
+    rc = main(TINY_CLI_ARGS + [
+        "--synthetic_chains", "12", "--synthetic_len", "20,40",
+        "--screen_batch", "4", "--top_k", "5", "--out", out])
+    assert rc == 0
+    record2 = check_cli_contract_text(capsys.readouterr().out, "screen")
+    assert record2["resumed"] and record2["pairs_resumed"] == 66
+    assert record2["pairs_scored"] == 0
+    assert record2["top_pair"] == record["top_pair"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP /screen route
+# ---------------------------------------------------------------------------
+
+
+def test_http_screen_route(engine, library, tmp_path):
+    import http.client
+
+    from deepinteract_tpu.serving import ServingServer
+
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"cx{i}.npz")
+        save_complex_npz(p, library.chains[2 * i].raw,
+                         library.chains[2 * i + 1].raw,
+                         np.zeros((0, 3), np.int32), f"cx{i}")
+        paths.append(p)
+
+    srv = ServingServer(engine, port=0, screen_max_pairs=10)
+    srv.serve_background()
+    try:
+        host, port = srv.address
+
+        def post(body):
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            try:
+                conn.request("POST", "/screen", body=json.dumps(body),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+
+        status, out = post({"npz_paths": paths, "top_k": 5})
+        assert status == 200
+        assert out["chains"] == 4 and out["pairs"] == 6
+        assert len(out["ranked"]) == 6
+        scores = [r["score"] for r in out["ranked"]]
+        assert scores == sorted(scores, reverse=True)
+        assert out["encode_reuse_ratio"] == pytest.approx(2 * 6 / 4)
+        assert out["latency_ms"] > 0
+
+        # Second identical screen: embeddings served from the shared
+        # cache — zero encoder passes.
+        status, out2 = post({"npz_paths": paths, "top_k": 5})
+        assert status == 200
+        assert out2["encodes_executed"] == 0
+        assert out2["emb_cache_hit_rate"] > 0
+
+        # Oversized screens are refused with guidance, not served.
+        status, err = post({"npz_paths": paths, "include_self": True,
+                            "max_pairs": 0})
+        assert status == 200  # 4 chains incl. self = 10 pairs, at limit
+        status, err = post({"npz_paths": []})
+        assert status == 400 and "npz_paths" in err["error"]
+        status, err = post({"npz_paths": ["/nope/missing.npz"]})
+        assert status == 400
+    finally:
+        srv.httpd.shutdown()
+        srv.httpd.server_close()

@@ -129,7 +129,7 @@ def test_long_context_512x384_sharded_train_step(rng):
     sharded train step on the 8-device mesh: tiled decoder (4x3 grid of
     128-tiles) composed with within-tile pair-axis sharding and data
     parallelism (2 data x 4 pair)."""
-    from deepinteract_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+    from deepinteract_tpu.parallel.mesh import make_mesh, mesh_context, replicate, shard_batch
     from deepinteract_tpu.parallel.train import (
         make_sharded_eval_step,
         make_sharded_train_step,
@@ -154,7 +154,7 @@ def test_long_context_512x384_sharded_train_step(rng):
     ])
     model = DeepInteract(cfg)
     mesh = make_mesh(num_data=2, num_pair=4)
-    with jax.set_mesh(mesh):
+    with mesh_context(mesh):
         state = create_train_state(
             model, jax.tree_util.tree_map(lambda x: x[:1], cx),
             optim_cfg=OptimConfig(steps_per_epoch=2, num_epochs=1),

@@ -1,0 +1,115 @@
+"""Assert a CLI capture ends in the entry point's machine-readable JSON.
+
+Generalizes ``tools/check_bench_contract.py`` (which stays as the bench
+headline's dedicated validator) to EVERY CLI whose final stdout line is a
+machine contract: drivers and operators parse the LAST line of a capture,
+and twice (BENCH_r01, BENCH_r05) a finished run landed ``"parsed": null``
+because something else printed last. One validator per contract kind
+makes that failure mode un-regressable across the whole CLI surface::
+
+    python -m deepinteract_tpu.cli.screen ... | tee log
+    python tools/check_cli_contract.py screen log
+
+    python tools/check_cli_contract.py bench bench_stdout.log
+    python tools/check_cli_contract.py tune tune_stdout.log
+
+Wired as a fast-tier test (tests/test_cli_contract.py) against the real
+entry points, so a key rename in any of them fails there first.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# Contract kinds: required keys + which of them must be numbers. "bench"
+# mirrors check_bench_contract.REQUIRED_KEYS (kept in sync by a test).
+CONTRACTS = {
+    "bench": {
+        "required": ("metric", "value", "unit", "vs_baseline"),
+        "numeric": ("value", "vs_baseline"),
+    },
+    "screen": {
+        "required": ("metric", "value", "unit", "pairs_total",
+                     "pairs_scored", "encode_reuse_ratio",
+                     "emb_cache_hit_rate", "ranked_out", "manifest"),
+        "numeric": ("value", "pairs_total", "pairs_scored",
+                    "encode_reuse_ratio", "emb_cache_hit_rate"),
+    },
+    "tune": {
+        "required": ("tuning_store", "device_kind", "model_signature",
+                     "buckets"),
+        "numeric": (),
+    },
+    "predict_topk": {
+        "required": ("metric", "value", "unit", "top_k",
+                     "top_contacts_out"),
+        "numeric": ("value", "top_k"),
+    },
+}
+
+
+def final_json_line(text: str) -> dict:
+    """Parse the final non-empty line as a JSON object (the shared
+    contract discipline); precise ValueError otherwise."""
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("capture is empty — no contract line to parse")
+    last = lines[-1].strip()
+    try:
+        record = json.loads(last)
+    except json.JSONDecodeError as exc:
+        raise ValueError(
+            f"final line is not JSON ({exc}); a driver would record "
+            f'"parsed": null. Line was: {last[:200]!r}')
+    if not isinstance(record, dict):
+        raise ValueError(f"final line parses to {type(record).__name__}, "
+                         "not an object")
+    return record
+
+
+def check_cli_contract_text(text: str, kind: str) -> dict:
+    """Validate ``text``'s final non-empty line against the ``kind``
+    contract; returns the parsed record, raises ValueError otherwise."""
+    if kind not in CONTRACTS:
+        raise ValueError(f"unknown contract kind {kind!r} "
+                         f"(want one of {sorted(CONTRACTS)})")
+    spec = CONTRACTS[kind]
+    record = final_json_line(text)
+    missing = [k for k in spec["required"] if k not in record]
+    if missing:
+        raise ValueError(f"{kind} contract is missing keys {missing}; "
+                         f"got {sorted(record)}")
+    for key in spec["numeric"]:
+        if isinstance(record[key], bool) or not isinstance(
+                record[key], (int, float)):
+            raise ValueError(
+                f"{kind} contract key {key!r} must be a number, got "
+                f"{type(record[key]).__name__} ({record[key]!r})")
+    return record
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: check_cli_contract.py <kind> [capture-file|-]",
+              file=sys.stderr)
+        return 2
+    kind = argv[0]
+    if len(argv) > 1 and argv[1] != "-":
+        with open(argv[1]) as fh:
+            text = fh.read()
+    else:
+        text = sys.stdin.read()
+    try:
+        record = check_cli_contract_text(text, kind)
+    except ValueError as exc:
+        print(f"CLI CONTRACT VIOLATION: {exc}", file=sys.stderr)
+        return 1
+    print(json.dumps({"contract_ok": True, "kind": kind,
+                      "keys": sorted(record)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
