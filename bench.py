@@ -99,6 +99,7 @@ SECTION_EST_S = {
     "b1_p512_tiled": 480,
     "b1_p128_deeplab": 300,
     "screening": 300,
+    "attribution": 240,
 }
 
 # NOTE: do NOT enable JAX_COMPILATION_CACHE_DIR here — executable
@@ -564,7 +565,8 @@ def _section_names(platform: str) -> list:
     # runs 397 ms/step; p512 803 ms/step), so the >256-residue tier's
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
-             "b1_p256", "b1_p384_tiled", "eval_path", "screening"]
+             "b1_p256", "b1_p384_tiled", "eval_path", "screening",
+             "attribution"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -1061,13 +1063,88 @@ def _run_screening_section(ctx, detail) -> None:
     _dump_partial(detail)
 
 
+def _run_attribution_section(ctx, detail) -> None:
+    """Device-time attribution of the serving forward (ISSUE-8): capture
+    a jax.profiler trace around a few warm predicts, parse it to per-op
+    device time (deepinteract_tpu/obs/device.py + attribution.py), and
+    reconcile against the compiled forward's HLO launch census — so the
+    bench artifact carries WHERE the milliseconds go, not just how many
+    there are. The top-3 ops and their shares land in the contract line.
+
+    DI_BENCH_PROFILE_DIR keeps the raw capture for
+    ``cli/attribute.py``/TensorBoard; default is a temp dir."""
+    import tempfile
+
+    import jax  # noqa: F401  (profiler backend must be live)
+
+    from deepinteract_tpu.obs import attribution as obs_attr
+    from deepinteract_tpu.obs import device as obs_device
+    from deepinteract_tpu.obs import hloquery
+    from deepinteract_tpu.obs import spans as obs_spans
+    from deepinteract_tpu.screening import ChainLibrary
+    from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+
+    iters = int(os.environ.get("DI_BENCH_ATTR_ITERS", "3"))
+    library = ChainLibrary.synthetic(2, 100, 110, seed=11)
+    ids = list(library.ids())
+    raw = {"graph1": library[ids[0]].raw, "graph2": library[ids[1]].raw,
+           "examples": np.zeros((0, 3), np.int32)}
+    engine = InferenceEngine(
+        ctx["make_model"]().cfg,
+        cfg=EngineConfig(max_batch=1, max_delay_ms=0.0,
+                         result_cache_size=0))
+    entry = {"iters": iters,
+             "interaction_stem": engine.model.cfg.interaction_stem,
+             "compute_dtype": ctx["bench_dtype"]}
+    detail["attribution"] = entry
+    try:
+        engine.predict(raw)  # compile + warm outside the capture
+        profile_dir = (os.environ.get("DI_BENCH_PROFILE_DIR")
+                       or tempfile.mkdtemp(prefix="di_bench_prof_"))
+        with obs_device.capture(profile_dir):
+            for _ in range(iters):
+                with obs_spans.span("predict"):
+                    engine.predict(raw)
+        census = None
+        executables = list(engine._executables.values())
+        if executables:
+            census = dict(hloquery.census_compiled(executables[0]))
+        trace = obs_device.load_profile(profile_dir,
+                                        phase_names=("predict",))
+        fwd_flops = analytic_forward_flops(1, 128)["forward_flops"]
+        report = obs_attr.build_report(
+            trace, top_n=10,
+            analytic_flops={"predict": float(fwd_flops)},
+            peak_flops=PEAK_FLOPS,
+            census=census, census_instances=iters,
+            census_meta={"source": "serving_forward_entry"})
+        entry["profile_dir"] = profile_dir
+        entry["total_device_ms"] = report["total_device_ms"]
+        entry["op_launches"] = report["op_launches"]
+        entry["top_ops"] = [
+            {"name": o["name"], "total_ms": o["total_ms"],
+             "share": o["share"], "op_class": o["op_class"],
+             "bound_guess": o["bound_guess"]}
+            for o in report["top_ops"][:5]]
+        entry["phases"] = report["phases"]
+        if "remask" in report:
+            entry["remask"] = report["remask"]
+    finally:
+        engine.close()
+    _log(json.dumps({"attribution": {
+        k: entry.get(k) for k in ("total_device_ms", "op_launches",
+                                  "top_ops", "remask")}}))
+    _dump_partial(detail)
+
+
 def _section_result_key(name: str):
     """Where a section's result (or error) lives in the detail dict:
     (container, key). Buckets nest under 'buckets'; the A/B and eval
     sections use the same top-level keys their successes always used."""
     if name == "eval_path":
         return None, "eval_path_b128"
-    if name in ("tuned_ab", "stem_ab", "precision_ab", "screening"):
+    if name in ("tuned_ab", "stem_ab", "precision_ab", "screening",
+                "attribution"):
         return None, name
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
@@ -1098,6 +1175,8 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_precision_ab_section(ctx, detail)
     elif name == "screening":
         _run_screening_section(ctx, detail)
+    elif name == "attribution":
+        _run_attribution_section(ctx, detail)
     elif name.startswith("ab_p"):
         _run_ab_section(int(name[4:]), ctx, detail)
     else:
@@ -1165,6 +1244,22 @@ def _build_headline(detail, scan_k) -> dict:
             entry["train_complexes_per_sec"], 2)
     if "analytic_train_mfu" in entry:
         line["analytic_train_mfu"] = round(entry["analytic_train_mfu"], 4)
+    attribution = detail.get("attribution", {})
+    if "top_ops" in attribution:
+        # Device-time attribution of the serving forward (ISSUE-8): the
+        # top-3 ops by measured device time and their shares, so the
+        # driver artifact ranks wall-clock sinks without re-parsing the
+        # raw trace.
+        line["attribution"] = {
+            "total_device_ms": attribution.get("total_device_ms"),
+            "top_ops": [
+                {"name": o["name"], "total_ms": o["total_ms"],
+                 "share": o["share"]}
+                for o in attribution["top_ops"][:3]],
+        }
+        if "remask" in attribution:
+            line["attribution"]["remask_share"] = (
+                attribution["remask"].get("share"))
     screening = detail.get("screening", {})
     if "screen_pairs_per_sec" in screening:
         # The bulk-screening workload's own throughput row (ISSUE-6):
@@ -1192,7 +1287,8 @@ def _is_partial(detail) -> bool:
     candidates = list(detail.get("buckets", {}).values())
     candidates += [v for k, v in detail.items()
                    if k.startswith(("attention_ab", "eval_path", "tuned_ab",
-                                    "stem_ab", "precision_ab", "screening"))
+                                    "stem_ab", "precision_ab", "screening",
+                                    "attribution"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates
                if isinstance(c, dict))

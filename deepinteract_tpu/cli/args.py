@@ -236,6 +236,11 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
                         "disables)")
     g.add_argument("--request_timeout_s", type=float, default=120.0,
                    help="per-request wait bound inside the HTTP handler")
+    g.add_argument("--events_out", type=str, default=None,
+                   help="span event log (JSONL) for request-scoped "
+                        "tracing: every traced request's queue-wait/"
+                        "compile/device decomposition lands here under "
+                        "its trace_id (obs/reqtrace.py)")
 
 
 def add_screening_args(p: argparse.ArgumentParser) -> None:

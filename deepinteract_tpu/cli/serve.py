@@ -49,12 +49,19 @@ def main(argv=None) -> int:
     add_serving_args(parser)
     args = parser.parse_args(argv)
 
+    from deepinteract_tpu.obs import spans as obs_spans
     from deepinteract_tpu.serving import EngineConfig, InferenceEngine, ServingServer
     from deepinteract_tpu.tuning.compile_cache import (
         enable_compile_cache,
         resolve_cache_dir,
     )
     from deepinteract_tpu.tuning.store import default_store_path
+
+    if args.events_out:
+        # Request-scoped tracing sink: every request's trace_id +
+        # queue-wait/compile/device decomposition (obs/reqtrace.py) is
+        # durable and joinable against the ?trace=1 response echo.
+        obs_spans.configure(args.events_out)
 
     enable_compile_cache(
         resolve_cache_dir(args.compile_cache_dir,

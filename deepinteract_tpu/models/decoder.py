@@ -234,8 +234,9 @@ class PVConv1x1(nn.Module):
     pad value goes through a broadcast-multiply + sum formulation of the
     same affine, which XLA fuses into a tiny reduce instead of paying a
     full conv/dot kernel launch (~24 us each on a v5e — 112 of them per
-    decoder forward measurably dominated the depad path's overhead,
-    tools/tiny_op_probe.py)."""
+    decoder forward measurably dominated the depad path's overhead;
+    measure with `python -m deepinteract_tpu.cli.attribute --census
+    decoder` over a --profile_dir capture)."""
 
     features: int
     dtype: Any = FLOAT32

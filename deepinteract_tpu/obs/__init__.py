@@ -17,13 +17,36 @@ the native-kernel pipeline:
   host id, current span path, and last-progress timestamp (the
   multi-host "which host is stuck, and where" debugging primitive).
 
+Below the host-side pillars sits the device-level accounting layer:
+
+* :mod:`deepinteract_tpu.obs.device` — jax.profiler trace capture +
+  trace-event JSON parsing into per-op device time and phase windows;
+* :mod:`deepinteract_tpu.obs.attribution` — the ``op_attribution``
+  report: per-op/per-opcode time shares, per-phase MFU, and the
+  census×time reconciliation against
+  :mod:`deepinteract_tpu.obs.hloquery` (compiled-HLO launch counts);
+* :mod:`deepinteract_tpu.obs.reqtrace` — request-scoped tracing: a
+  ``trace_id`` minted per serving request with a queue-wait / assembly /
+  compile / device decomposition in ``/metrics`` and ``events.jsonl``.
+
 The package deliberately depends on nothing outside the standard library
-(``jax`` is imported lazily, and only when profiler annotations are
-enabled), so every layer of the system can import it unconditionally.
+(``jax`` is imported lazily — only for profiler annotations and the
+:func:`deepinteract_tpu.obs.device.capture` window), so every layer of
+the system can import it unconditionally.
 """
 
-from deepinteract_tpu.obs import expfmt, heartbeat, metrics, spans  # noqa: F401
+from deepinteract_tpu.obs import (  # noqa: F401
+    attribution,
+    device,
+    expfmt,
+    heartbeat,
+    hloquery,
+    metrics,
+    reqtrace,
+    spans,
+)
 from deepinteract_tpu.obs.heartbeat import Heartbeat  # noqa: F401
+from deepinteract_tpu.obs.reqtrace import RequestTrace  # noqa: F401
 from deepinteract_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,

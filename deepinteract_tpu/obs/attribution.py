@@ -1,0 +1,307 @@
+"""Device-time attribution: who gets every millisecond (and FLOP).
+
+Consumes a parsed :class:`~deepinteract_tpu.obs.device.DeviceTrace` and
+produces the ``op_attribution`` report — the machine-readable artifact
+ROADMAP items 2/3 burn down from:
+
+* **per-op / per-opcode time** — total device microseconds, launch
+  counts, and time share for every op and every opcode class, with a
+  roofline *bound guess* per opcode (is this op class compute-bound on
+  the MXU or bandwidth-bound on HBM?);
+* **per-phase decomposition** — op events fall into the PR-3 span
+  windows (``device_step``, ``predict``, ``screen_decode``, ...) by time
+  overlap, so "device time inside device_step" is a first-class number,
+  with analytic-FLOP MFU per phase when the caller supplies FLOP counts;
+* **census reconciliation** — the :mod:`deepinteract_tpu.obs.hloquery`
+  entry census (launch *counts* from compiled HLO) joined against the
+  measured per-opcode *time*, so "112 re-mask launches" becomes "X ms,
+  Y% of the step".
+
+Report schema (``schema`` key = ``op_attribution/v1``)::
+
+    {"schema": "op_attribution/v1", "device": ..., "total_device_ms": ...,
+     "top_ops": [{"name", "opcode", "op_class", "bound_guess", "count",
+                  "total_ms", "share"}],
+     "by_opcode": [...same minus name...],
+     "phases": [{"name", "instances", "wall_ms", "device_ms",
+                 "device_share_of_wall", "analytic_flops", "mfu"}],
+     "census_reconciliation": [{"opcode", "census_count",
+                                "measured_count", "total_ms", "share",
+                                "ms_per_launch"}],
+     "unattributed_ms": ..., "notes": [...]}
+
+Pure stdlib + arithmetic; nothing here touches jax or the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from deepinteract_tpu.obs.device import DeviceTrace, opcode_of
+
+SCHEMA = "op_attribution/v1"
+
+# Opcode -> (op_class, roofline bound guess). Matched by substring in
+# priority order: the first hit wins. "compute" = FLOP-limited on the
+# MXU/ALU at realistic shapes; "memory" = HBM/VMEM bandwidth-limited
+# (elementwise, data movement, masking); "communication" = ICI/DCN.
+_CLASS_RULES: Sequence = (
+    ("all-reduce", "communication", "communication"),
+    ("all-gather", "communication", "communication"),
+    ("all-to-all", "communication", "communication"),
+    ("reduce-scatter", "communication", "communication"),
+    ("collective", "communication", "communication"),
+    ("infeed", "host-transfer", "host"),
+    ("outfeed", "host-transfer", "host"),
+    ("copy", "data-movement", "memory"),
+    ("transpose", "data-movement", "memory"),
+    ("reshape", "data-movement", "memory"),
+    ("slice", "data-movement", "memory"),
+    ("concatenate", "data-movement", "memory"),
+    ("pad", "data-movement", "memory"),
+    ("gather", "data-movement", "memory"),
+    ("scatter", "data-movement", "memory"),
+    ("broadcast", "data-movement", "memory"),
+    # "convert" MUST precede the bare "conv" needle: a dtype cast is
+    # bandwidth-bound data movement, not an MXU op.
+    ("convert", "elementwise", "memory"),
+    ("convolution", "matmul", "compute"),
+    ("conv", "matmul", "compute"),
+    ("dot", "matmul", "compute"),
+    ("cholesky", "matmul", "compute"),
+    ("fft", "matmul", "compute"),
+    ("custom-call", "custom-call", "unknown"),
+    ("fusion", "fusion", "memory"),
+    ("reduce-window", "reduction", "memory"),
+    ("reduce", "reduction", "memory"),
+    ("select", "elementwise", "memory"),
+    ("compare", "elementwise", "memory"),
+    ("while", "control-flow", "unknown"),
+    ("call", "control-flow", "unknown"),
+    ("conditional", "control-flow", "unknown"),
+)
+_DEFAULT_CLASS = ("elementwise", "memory")
+
+# Opcodes that implement masking / re-masking in the decoder (select and
+# the broadcast/and chain feeding it) — the census anomaly ROADMAP item 2
+# names. Surfaced as a dedicated note when they carry measured time.
+REMASK_OPCODES = ("select", "broadcast", "and", "multiply")
+
+
+def classify_opcode(opcode: str):
+    """(op_class, bound_guess) for one opcode."""
+    low = opcode.lower()
+    for needle, op_class, bound in _CLASS_RULES:
+        if needle in low:
+            return op_class, bound
+    return _DEFAULT_CLASS
+
+
+@dataclasses.dataclass
+class _Agg:
+    count: int = 0
+    total_us: float = 0.0
+
+
+def _rounded_ms(us: float) -> float:
+    return round(us / 1e3, 4)
+
+
+def _share(us: float, total_us: float) -> float:
+    return round(us / total_us, 4) if total_us > 0 else 0.0
+
+
+def aggregate_ops(trace: DeviceTrace, top_n: int = 20) -> Dict:
+    """Per-op and per-opcode rollups over every op event in the trace."""
+    by_name: Dict[str, _Agg] = defaultdict(_Agg)
+    by_opcode: Dict[str, _Agg] = defaultdict(_Agg)
+    for op in trace.ops:
+        code = opcode_of(op.name)
+        a = by_name[op.name]
+        a.count += 1
+        a.total_us += op.dur_us
+        b = by_opcode[code]
+        b.count += 1
+        b.total_us += op.dur_us
+    total_us = trace.total_device_us
+
+    def row(name: str, agg: _Agg, with_name: bool) -> Dict:
+        code = opcode_of(name) if with_name else name
+        op_class, bound = classify_opcode(code)
+        out = {
+            "opcode": code,
+            "op_class": op_class,
+            "bound_guess": bound,
+            "count": agg.count,
+            "total_ms": _rounded_ms(agg.total_us),
+            "share": _share(agg.total_us, total_us),
+        }
+        if with_name:
+            out = {"name": name, **out}
+        return out
+
+    top_ops = [row(n, a, True) for n, a in sorted(
+        by_name.items(), key=lambda kv: -kv[1].total_us)[:top_n]]
+    opcode_rows = [row(c, a, False) for c, a in sorted(
+        by_opcode.items(), key=lambda kv: -kv[1].total_us)]
+    return {
+        "total_device_ms": _rounded_ms(total_us),
+        "op_launches": sum(a.count for a in by_opcode.values()),
+        "top_ops": top_ops,
+        "by_opcode": opcode_rows,
+    }
+
+
+def attribute_phases(
+    trace: DeviceTrace,
+    analytic_flops: Optional[Mapping[str, float]] = None,
+    peak_flops: float = 0.0,
+) -> Dict:
+    """Assign each op event to the phase window containing its midpoint.
+
+    ``analytic_flops`` maps phase name -> FLOPs per phase INSTANCE (the
+    bench's analytic counts); with ``peak_flops`` it yields a per-phase
+    measured-device-time MFU. Returns {"phases": [...],
+    "unattributed_ms": ...}. Windows of the same name aggregate; nested
+    windows attribute to the INNERMOST (shortest) container, so an
+    ``epoch`` umbrella does not swallow its ``device_step`` children."""
+    import bisect
+
+    analytic_flops = dict(analytic_flops or {})
+    windows = sorted(trace.phases, key=lambda w: w.start_us)
+    starts = [w.start_us for w in windows]
+    max_dur = max((w.dur_us for w in windows), default=0.0)
+    per_phase_us: Dict[str, float] = defaultdict(float)
+    instances: Dict[str, int] = defaultdict(int)
+    wall_us: Dict[str, float] = defaultdict(float)
+    for w in windows:
+        instances[w.name] += 1
+        wall_us[w.name] += w.dur_us
+    unattributed_us = 0.0
+    for op in trace.ops:
+        # Only windows starting at or before the midpoint can contain
+        # it, and none starting more than max_dur earlier — a bounded
+        # backward scan from the bisect point keeps long multi-step
+        # captures (10^5+ ops x 10^2+ windows) out of O(ops*windows).
+        mid = op.mid_us
+        best = None
+        i = bisect.bisect_right(starts, mid) - 1
+        while i >= 0 and mid - starts[i] <= max_dur:
+            w = windows[i]
+            if w.contains(mid) and (best is None or w.dur_us < best.dur_us):
+                best = w
+            i -= 1
+        if best is None:
+            unattributed_us += op.dur_us
+        else:
+            per_phase_us[best.name] += op.dur_us
+    phases = []
+    for name in instances:
+        dev_us = per_phase_us.get(name, 0.0)
+        entry = {
+            "name": name,
+            "instances": instances[name],
+            "wall_ms": _rounded_ms(wall_us[name]),
+            "device_ms": _rounded_ms(dev_us),
+            "device_share_of_wall": _share(dev_us, wall_us[name]),
+        }
+        if name in analytic_flops:
+            flops_total = float(analytic_flops[name]) * instances[name]
+            entry["analytic_flops"] = flops_total
+            if peak_flops > 0 and dev_us > 0:
+                entry["mfu"] = round(
+                    flops_total / (dev_us / 1e6) / peak_flops, 5)
+        phases.append(entry)
+    phases.sort(key=lambda p: -p["device_ms"])
+    return {"phases": phases, "unattributed_ms": _rounded_ms(unattributed_us)}
+
+
+def reconcile_census(census: Mapping[str, int], opcode_rows: Sequence[Dict],
+                     instances: int = 1) -> List[Dict]:
+    """Join compiled-HLO launch counts against measured per-opcode time.
+
+    ``census`` is an :func:`deepinteract_tpu.obs.hloquery.entry_census`
+    mapping (one compiled step); ``instances`` is how many executions of
+    that computation the trace covers, so ``measured_count`` can be read
+    against ``census_count * instances``. Census opcodes with zero
+    measured time still appear (count with no time = fused away or below
+    the profiler's resolution — that, too, is an answer)."""
+    measured = {r["opcode"]: r for r in opcode_rows}
+    rows = []
+    for opcode in sorted(set(census) | set(measured)):
+        m = measured.get(opcode)
+        total_ms = m["total_ms"] if m else 0.0
+        count = m["count"] if m else 0
+        rows.append({
+            "opcode": opcode,
+            "census_count": int(census.get(opcode, 0)),
+            "census_instances": int(instances),
+            "measured_count": count,
+            "total_ms": total_ms,
+            "share": m["share"] if m else 0.0,
+            "ms_per_launch": round(total_ms / count, 5) if count else 0.0,
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def build_report(
+    trace: DeviceTrace,
+    top_n: int = 20,
+    analytic_flops: Optional[Mapping[str, float]] = None,
+    peak_flops: float = 0.0,
+    census: Optional[Mapping[str, int]] = None,
+    census_instances: int = 1,
+    census_meta: Optional[Dict] = None,
+    device: str = "",
+) -> Dict:
+    """The full ``op_attribution/v1`` report (see module docstring)."""
+    agg = aggregate_ops(trace, top_n=top_n)
+    phase_part = attribute_phases(trace, analytic_flops, peak_flops)
+    notes: List[str] = []
+    report = {
+        "schema": SCHEMA,
+        "device": device or next(iter(trace.processes.values()), ""),
+        "trace_files": list(trace.files),
+        **agg,
+        **phase_part,
+        "peak_flops": peak_flops or None,
+    }
+    if census is not None:
+        rows = reconcile_census(census, agg["by_opcode"],
+                                instances=census_instances)
+        report["census_reconciliation"] = rows
+        if census_meta:
+            report["census_meta"] = dict(census_meta)
+        remask_ms = sum(r["total_ms"] for r in rows
+                        if r["opcode"] in REMASK_OPCODES)
+        remask_launches = sum(r["census_count"] for r in rows
+                              if r["opcode"] in REMASK_OPCODES)
+        # XLA usually fuses the re-mask select into its neighbor (the
+        # decoder's ELU+select fusions): those fusions' full time is an
+        # UPPER bound on re-mask cost, the bare opcodes a lower one.
+        fused_ms = sum(r["total_ms"] for r in rows
+                       if "select" in r["opcode"]
+                       and r["opcode"] not in REMASK_OPCODES)
+        total_ms = report["total_device_ms"]
+        notes.append(
+            f"re-mask opcodes {list(REMASK_OPCODES)}: {remask_launches} "
+            f"census launches, {remask_ms:.3f} ms measured bare "
+            f"({_share(remask_ms, total_ms)} of device time) + "
+            f"{fused_ms:.3f} ms inside select-carrying fusions (upper "
+            "bound)")
+        report["remask"] = {
+            "opcodes": list(REMASK_OPCODES),
+            "census_launches": remask_launches,
+            "total_ms": round(remask_ms, 4),
+            "share": _share(remask_ms, total_ms),
+            "select_fusion_ms": round(fused_ms, 4),
+            "select_fusion_share": _share(fused_ms, total_ms),
+        }
+    if not trace.phases:
+        notes.append("no phase windows found — was the span annotation "
+                     "overlay enabled during the capture?")
+    report["notes"] = notes
+    return report

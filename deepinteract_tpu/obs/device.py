@@ -1,0 +1,273 @@
+"""Device-trace capture and parsing: per-op time out of a profiler trace.
+
+The PR-3 telemetry stops at host-side phase spans; this module opens the
+layer below. A ``jax.profiler`` capture (``--profile_dir``, the engine's
+bench section, or :func:`capture` here) writes a trace-event JSON under
+``<dir>/plugins/profile/<ts>/*.trace.json.gz``; this module finds it,
+decodes it, and reduces the event soup to the two things attribution
+needs:
+
+* **op events** — one timed execution of one XLA op. Identified by the
+  ``hlo_op`` arg the XLA profiler attaches on every backend (CPU thunk
+  threads, TPU "XLA Ops" device lines), plus — belt over suspenders on
+  device backends — any X event on a ``/device:*`` pid's "XLA Ops"
+  thread. ``call`` wrapper events (the CPU thunk executor nests the real
+  op inside a same-thread ``call``) are dropped so time is not counted
+  twice.
+* **phase windows** — the PR-3 span overlay
+  (:func:`deepinteract_tpu.obs.spans.set_profiler_annotations`) shows up
+  as plain named events on host threads; each becomes a window that op
+  events are attributed into by time overlap.
+
+Everything after the capture is pure stdlib JSON processing: the parser
+runs anywhere (the test fixture is a checked-in CPU trace), and jax is
+imported only inside :func:`capture`.
+
+All timestamps are trace-native microseconds (the chrome trace-event
+convention jax emits).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Host-side names that look like annotations but are runtime internals.
+# Anything with "::", "(", or a "$<file>:<line>" python-tracer prefix is
+# already rejected by _PHASE_NAME_RE; these are the identifier-shaped
+# leftovers observed across jax versions.
+_PHASE_EXCLUDE = frozenset({
+    "process_name", "thread_name", "checkpoint", "flush",
+    "ParseArguments", "ExecuteOnCpu", "RunExecutable",
+})
+_PHASE_NAME_RE = re.compile(r"^[A-Za-z_][\w.\-/]*$")
+
+# Op events whose interval CONTAINS their body's separately-traced op
+# events; summing them alongside their children would double the time.
+_WRAPPER_OPCODES = frozenset({"call", "while", "conditional"})
+
+
+@dataclasses.dataclass(frozen=True)
+class OpEvent:
+    """One timed execution of one XLA op on one trace line."""
+
+    name: str          # full HLO op name, e.g. "fusion.1205" / "dot.4"
+    start_us: float
+    dur_us: float
+    pid: int
+    tid: int
+    hlo_module: str = ""
+
+    @property
+    def mid_us(self) -> float:
+        return self.start_us + self.dur_us / 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseWindow:
+    """One instance of a named phase (a span annotation) on the trace."""
+
+    name: str
+    start_us: float
+    dur_us: float
+
+    @property
+    def end_us(self) -> float:
+        return self.start_us + self.dur_us
+
+    def contains(self, t_us: float) -> bool:
+        return self.start_us <= t_us < self.end_us
+
+
+@dataclasses.dataclass
+class DeviceTrace:
+    """Parsed view of one (or several merged) trace-event files."""
+
+    ops: List[OpEvent]
+    phases: List[PhaseWindow]
+    processes: Dict[int, str]
+    files: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def total_device_us(self) -> float:
+        return sum(op.dur_us for op in self.ops)
+
+    def phase_names(self) -> List[str]:
+        seen: Dict[str, None] = {}
+        for w in self.phases:
+            seen.setdefault(w.name, None)
+        return list(seen)
+
+
+def find_trace_files(profile_dir: str) -> List[str]:
+    """Every ``*.trace.json[.gz]`` under ``profile_dir`` (a raw file path
+    is also accepted), newest profiler run first within the standard
+    ``plugins/profile/<timestamp>/`` layout."""
+    if os.path.isfile(profile_dir):
+        return [profile_dir]
+    hits = []
+    for pat in ("*.trace.json.gz", "*.trace.json"):
+        hits += glob.glob(os.path.join(profile_dir, "**", pat),
+                          recursive=True)
+    # Newest capture directory first; stable name order within one.
+    return sorted(set(hits), key=lambda p: (os.path.dirname(p), p),
+                  reverse=True)
+
+
+def load_trace_json(path: str) -> Dict[str, Any]:
+    """One trace file -> its decoded JSON dict (gzip-transparent)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fh:  # type: ignore[operator]
+        return json.loads(fh.read().decode("utf-8"))
+
+
+def _is_op_event(event: Dict[str, Any], pname: str, tname: str) -> bool:
+    args = event.get("args")
+    if isinstance(args, dict) and "hlo_op" in args:
+        return True
+    # TPU/GPU device lines: ops live on the device pid's "XLA Ops"
+    # threads and may omit per-event args in some exporter versions.
+    return pname.startswith("/device:") and "XLA Ops" in tname
+
+
+def parse_trace(
+    trace_json: Dict[str, Any],
+    phase_names: Optional[Sequence[str]] = None,
+) -> DeviceTrace:
+    """Reduce one trace-event JSON to op events + phase windows.
+
+    ``phase_names``: restrict phase windows to these span names. Default
+    (None) auto-detects: any identifier-shaped named event on a host
+    thread that is neither an op event nor a known runtime internal —
+    which is exactly what the span annotation overlay emits."""
+    events = trace_json.get("traceEvents", [])
+    pname: Dict[int, str] = {}
+    tname: Dict[Tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pname[e.get("pid", 0)] = str(e.get("args", {}).get("name", ""))
+        elif e.get("name") == "thread_name":
+            tname[(e.get("pid", 0), e.get("tid", 0))] = str(
+                e.get("args", {}).get("name", ""))
+
+    wanted = set(phase_names) if phase_names is not None else None
+    ops: List[OpEvent] = []
+    phases: List[PhaseWindow] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        pid = int(e.get("pid", 0))
+        tid = int(e.get("tid", 0))
+        pn = pname.get(pid, "")
+        tn = tname.get((pid, tid), "")
+        if _is_op_event(e, pn, tn):
+            args = e.get("args") or {}
+            op_name = str(args.get("hlo_op", name))
+            if _opcode_of(op_name) in _WRAPPER_OPCODES:
+                # Control-flow wrappers ENCLOSE their body ops' events
+                # (the CPU thunk executor nests call/while/conditional
+                # around the real work) — counting both would double the
+                # time.
+                continue
+            ops.append(OpEvent(
+                name=op_name,
+                start_us=float(e.get("ts", 0.0)),
+                dur_us=float(e.get("dur", 0.0)),
+                pid=pid, tid=tid,
+                hlo_module=str(args.get("hlo_module", "")),
+            ))
+            continue
+        if pn.startswith("/device:"):
+            continue  # device-side non-op lines are never phases
+        if wanted is not None:
+            if name in wanted:
+                phases.append(PhaseWindow(name, float(e.get("ts", 0.0)),
+                                          float(e.get("dur", 0.0))))
+            continue
+        if (name in _PHASE_EXCLUDE or not _PHASE_NAME_RE.match(name)
+                or float(e.get("dur", 0.0)) <= 0.0):
+            continue
+        phases.append(PhaseWindow(name, float(e.get("ts", 0.0)),
+                                  float(e.get("dur", 0.0))))
+    phases.sort(key=lambda w: w.start_us)
+    ops.sort(key=lambda o: o.start_us)
+    return DeviceTrace(ops=ops, phases=phases, processes=dict(pname))
+
+
+def load_profile(profile_dir: str,
+                 phase_names: Optional[Sequence[str]] = None,
+                 merge: bool = False) -> DeviceTrace:
+    """Find + load + parse a profile directory (or a single trace file).
+
+    Multi-host captures write one trace file per host; ``merge=False``
+    (the default) parses only the newest capture's first file — per-op
+    time from one host is what single-process serving/training wants.
+    ``merge=True`` concatenates all files (timestamps are per-host
+    clocks; phase matching stays correct because windows and ops come
+    from the same file's clock only when merged file count is 1 — use
+    with care)."""
+    files = find_trace_files(profile_dir)
+    if not files:
+        raise FileNotFoundError(
+            f"no *.trace.json(.gz) under {profile_dir!r} — was a "
+            "jax.profiler capture written there?")
+    use = files if merge else files[:1]
+    traces = [parse_trace(load_trace_json(p), phase_names) for p in use]
+    out = traces[0]
+    for extra in traces[1:]:
+        out.ops.extend(extra.ops)
+        out.phases.extend(extra.phases)
+        out.processes.update(extra.processes)
+    out.files = list(use)
+    return out
+
+
+def _opcode_of(name: str) -> str:
+    """``"tanh.5.clone"`` -> ``"tanh"``; ``"fusion.1205"`` -> ``"fusion"``;
+    ``"reduce-window"`` stays itself. HLO op names are the opcode plus
+    numeric/clone suffixes."""
+    base = name.lstrip("%")
+    for part in base.split("."):
+        if part and not part.isdigit() and part != "clone":
+            return part
+        if part and part.isdigit():
+            break
+    return base.split(".")[0]
+
+
+# Re-exported for attribution (one name grammar, one implementation).
+opcode_of = _opcode_of
+
+
+@contextlib.contextmanager
+def capture(profile_dir: str, annotate_spans: bool = True):
+    """``with capture(dir): ...`` — a jax.profiler trace window with the
+    PR-3 span overlay enabled, so the capture comes out phase-labeled.
+    The previous annotation flag is restored on exit."""
+    import jax
+
+    from deepinteract_tpu.obs import spans as obs_spans
+
+    prev = obs_spans.annotations_enabled()
+    os.makedirs(profile_dir, exist_ok=True)
+    jax.profiler.start_trace(profile_dir)
+    if annotate_spans:
+        obs_spans.set_profiler_annotations(True)
+    try:
+        yield profile_dir
+    finally:
+        obs_spans.set_profiler_annotations(prev)
+        jax.profiler.stop_trace()
+
+
+def iter_op_events(trace: DeviceTrace) -> Iterable[OpEvent]:
+    return iter(trace.ops)

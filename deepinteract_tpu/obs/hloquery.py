@@ -1,0 +1,124 @@
+"""Queries over compiled-HLO text: launch census, per-computation rollups.
+
+Promoted from the one-off ``tools/hlo_probe.py`` (which remains as a thin
+CLI shim) so the census is an importable building block: the attribution
+layer (:mod:`deepinteract_tpu.obs.attribution`) joins these *counts*
+against measured per-op *time* from a profiler trace, turning "the masked
+decoder schedules 112 re-mask launches" into "those launches cost X ms,
+Y% of the step".
+
+Everything here is pure text processing over ``compiled.as_text()``
+output — no jax import, no device, safe in the fast test tier. The
+opcode grammar matched is the optimized-HLO dump format::
+
+    ENTRY main.42 {
+      ...
+      %fusion.3 = f32[128,128]{1,0} fusion(%p0), kind=kLoop, ...
+      dot.4 = f32[256,256]{1,0} dot(x, y), lhs_contracting_dims={1}, ...
+    }
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List, Tuple
+
+# "<name> = <shape> <opcode>(" or "<opcode>.<n>(" — the third token's
+# leading opcode, exactly the grammar the old hlo_probe matched.
+_OP_RE = re.compile(r"\s+\S+ = \S+ ([a-z0-9\-]+)[.(]")
+# A computation header: "comp_name (params) -> result {" with an optional
+# ENTRY prefix and optional leading %.
+_COMP_RE = re.compile(r"(?:ENTRY )?%?([\w.\-]+)[ ]*\([^)]*\) -> ")
+
+
+def entry_census(txt: str) -> Counter:
+    """Opcode counts of the ENTRY computation's top-level ops — the
+    number of kernel launches XLA schedules at the top level."""
+    counts: Counter = Counter()
+    in_entry = False
+    for line in txt.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if line.startswith("}"):
+                break
+            m = _OP_RE.match(line)
+            if m:
+                counts[m.group(1)] += 1
+    return counts
+
+
+def computation_census(txt: str) -> Dict[str, Counter]:
+    """Opcode counts per computation (fusion bodies, scan bodies, the
+    entry) — where the entry census says "one while", this says what the
+    while's body actually schedules."""
+    comps: Dict[str, Counter] = {}
+    cur = None
+    for line in txt.splitlines():
+        m = _COMP_RE.match(line)
+        if m:
+            cur = m.group(1)
+            comps[cur] = Counter()
+            continue
+        if cur is not None:
+            if line.startswith("}"):
+                cur = None
+                continue
+            m2 = _OP_RE.match(line)
+            if m2:
+                comps[cur][m2.group(1)] += 1
+    return comps
+
+
+def top_computations(txt: str, n: int = 4) -> List[Tuple[str, Counter]]:
+    """The ``n`` computations with the most ops, largest first."""
+    comps = computation_census(txt)
+    return sorted(comps.items(), key=lambda kv: -sum(kv[1].values()))[:n]
+
+
+def census_compiled(compiled) -> Counter:
+    """Entry census of an already-compiled executable (``jit(f).lower(...)
+    .compile()``)."""
+    return entry_census(compiled.as_text())
+
+
+def decoder_census(pad: int = 128, masked: bool = True,
+                   decoder_cfg=None) -> Tuple[Counter, Dict]:
+    """Compile the interaction decoder forward on the CURRENT backend and
+    census its entry computation — the importable version of the old
+    ``tools/hlo_probe.py`` main path. Returns (census, meta) where meta
+    records the device and compiled shapes.
+
+    This is the only function here that imports jax and pays a compile;
+    callers that already hold a trace + canned census use the pure
+    functions above instead."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
+
+    rng = np.random.default_rng(0)
+    cfg = decoder_cfg or DecoderConfig()
+    x = jnp.asarray(
+        rng.standard_normal((1, pad, pad, cfg.in_channels)).astype(np.float32))
+    mask = None
+    if masked:
+        mask_np = np.zeros((1, pad, pad), bool)
+        mask_np[:, : max(1, pad - 20), : max(1, pad - 28)] = True
+        mask = jnp.asarray(mask_np)
+    model = InteractionDecoder(cfg)
+    variables = model.init(jax.random.PRNGKey(0), x, mask)
+    compiled = jax.jit(
+        lambda v, xx: model.apply(v, xx, mask)
+    ).lower(variables, x).compile()
+    meta = {
+        "device": jax.devices()[0].device_kind,
+        "platform": jax.devices()[0].platform,
+        "pad": int(pad),
+        "masked": bool(masked),
+        "source": "decoder_forward",
+    }
+    return census_compiled(compiled), meta

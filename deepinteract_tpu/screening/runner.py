@@ -211,12 +211,17 @@ class ScreenRunner:
         manifest: Optional[ScreenManifest] = None,
         guard=None,
         after_batch: Optional[Callable[[int], None]] = None,
+        trace_id: str = "",
     ) -> ScreenResult:
         """Score ``pairs`` (chain-id tuples); see module docstring.
 
         ``guard`` is a PR-1 PreemptionGuard (or any object with a
         ``requested`` flag) polled at decode-batch boundaries.
-        ``after_batch(num_batches)`` is a test hook (fault injection)."""
+        ``after_batch(num_batches)`` is a test hook (fault injection).
+        ``trace_id`` (request-scoped tracing, obs/reqtrace.py) labels
+        this screen's span events so one id connects the HTTP response,
+        ``events.jsonl``, and the phase histograms."""
+        trace_attrs = {"trace_id": trace_id} if trace_id else {}
         resumed_pairs = 0
         resumed = False
         if manifest is not None:
@@ -227,7 +232,8 @@ class ScreenRunner:
 
         needed = sorted({cid for p in pairs for cid in p})
         t0 = time.perf_counter()
-        with obs_spans.span("screen_encode", chains=len(needed)):
+        with obs_spans.span("screen_encode", chains=len(needed),
+                            **trace_attrs):
             emb, executed, enc_hits, enc_batches = self.ensure_embeddings(
                 library, needed)
         encode_s = time.perf_counter() - t0
@@ -248,7 +254,8 @@ class ScreenRunner:
         preempted = False
         run_records: List[Dict] = []
         t0 = time.perf_counter()
-        with obs_spans.span("screen_decode", pairs=len(pairs)):
+        with obs_spans.span("screen_decode", pairs=len(pairs),
+                            **trace_attrs):
             for (b1, b2), items in sorted(groups.items()):
                 if preempted:
                     break

@@ -473,9 +473,13 @@ class InferenceEngine:
                         int(g["edge_feats"].shape[2])))
         return tuple(sig)
 
-    def submit(self, raw: Dict) -> Future:
+    def submit(self, raw: Dict, reqtrace=None) -> Future:
         """Future-returning enqueue. ``raw`` is a loaded complex dict
-        (``data/io.py`` schema: graph1/graph2/examples).
+        (``data/io.py`` schema: graph1/graph2/examples). ``reqtrace`` is
+        an optional :class:`deepinteract_tpu.obs.reqtrace.RequestTrace`
+        carried through the scheduler queue to the flush; when given, the
+        result dict gains a ``trace`` decomposition (queue-wait /
+        assembly / compile / device) under the request's ``trace_id``.
 
         Result contract: ``probs`` is a READ-ONLY array (it may be shared
         with the result cache) — ``.copy()`` it before mutating."""
@@ -487,24 +491,44 @@ class InferenceEngine:
             if hit is not None:
                 _CACHE_HITS.inc()
                 fut: Future = Future()
-                fut.set_result(dict(hit, cached=True))
+                result = dict(hit, cached=True)
+                if reqtrace is not None:
+                    # A hit never queues or touches the device: every
+                    # phase is legitimately zero.
+                    result["trace"] = reqtrace.finish(cached=True)
+                fut.set_result(result)
                 return fut
         n1, n2 = complex_lengths(raw)
         b1, b2 = self.bucket_for(n1, n2)
+        if reqtrace is not None:
+            reqtrace.mark("submit")
         return self.scheduler.submit(
             (b1, b2) + self._shape_signature(raw),
-            {"raw": raw, "n1": n1, "n2": n2, "cache_key": key},
+            {"raw": raw, "n1": n1, "n2": n2, "cache_key": key,
+             "reqtrace": reqtrace},
         )
 
-    def predict(self, raw: Dict, timeout: Optional[float] = None) -> Dict:
+    def predict(self, raw: Dict, timeout: Optional[float] = None,
+                reqtrace=None) -> Dict:
         """Blocking single-complex prediction through the same batched
         path (so even sequential callers share warm executables)."""
-        return self.submit(raw).result(timeout=timeout)
+        return self.submit(raw, reqtrace=reqtrace).result(timeout=timeout)
 
     def _flush(self, bucket_key, items) -> list:
         """One coalesced device dispatch for same-bucket requests — runs on
         the scheduler's worker thread. ``bucket_key`` is (b1, b2) plus the
-        per-graph shape signature (see :meth:`_shape_signature`)."""
+        per-graph shape signature (see :meth:`_shape_signature`).
+
+        Request-trace phase boundaries (batch-shared; each traced request
+        records the batch's value with its ``coalesced`` count): dequeue
+        closes queue_wait, then assembly (featurize/pad/stack), then
+        executable acquisition (compile — ≈0 warm), then dispatch+fetch
+        (device)."""
+        traces = [it.get("reqtrace") for it in items]
+        t_dequeue = time.perf_counter()
+        for rt in traces:
+            if rt is not None:
+                rt.set_phase("queue_wait", rt.since("submit"))
         b1, b2 = bucket_key[0], bucket_key[1]
         complexes = [
             to_paired_complex(it["raw"], n_pad1=b1, n_pad2=b2,
@@ -515,10 +539,18 @@ class InferenceEngine:
         pad_slots = slots - len(complexes)
         complexes.extend([complexes[0]] * pad_slots)
         batch = stack_complexes(complexes)
+        t_assembled = time.perf_counter()
         compiled = self._executable_for(tuple(bucket_key) + (slots,), batch)
+        t_compiled = time.perf_counter()
         probs = np.asarray(
             compiled(self.params, self.batch_stats, batch.graph1, batch.graph2)
         )
+        t_fetched = time.perf_counter()
+        for rt in traces:
+            if rt is not None:
+                rt.set_phase("batch_assembly", t_assembled - t_dequeue)
+                rt.set_phase("compile", t_compiled - t_assembled)
+                rt.set_phase("device", t_fetched - t_compiled)
         self._executed_batches += 1
         self._executed_requests += len(items)
         self._padded_slots += pad_slots
@@ -544,8 +576,13 @@ class InferenceEngine:
             if it["cache_key"] is not None:
                 # The cache holds its OWN dict (sharing only the
                 # immutable array), so key-level mutations by the first
-                # caller cannot reach later hits either.
+                # caller cannot reach later hits either. The cached copy
+                # is snapshotted BEFORE the trace block is attached — a
+                # later hit is a different request with its own trace.
                 self.cache.put(it["cache_key"], dict(result))
+            rt = traces[i]
+            if rt is not None:
+                result["trace"] = rt.finish(coalesced=len(items))
             results.append(result)
         return results
 

@@ -25,7 +25,18 @@ Endpoints:
   those.
 * ``GET /healthz`` — liveness + draining flag.
 * ``GET /stats`` — queue depth, per-bucket compile inventory, result-cache
-  hit rate, and request-latency percentiles.
+  hit rate, request-latency percentiles, and a ``screening`` block
+  (``/screen`` request count + shared embedding-cache hit rate).
+
+Request-scoped tracing: every ``POST /predict`` / ``POST /screen`` mints
+a ``trace_id`` (:mod:`deepinteract_tpu.obs.reqtrace`) that is carried
+through the scheduler queue and the engine's flush and echoed in the
+response. Appending ``?trace=1`` to either route additionally returns
+the full latency decomposition (queue-wait / batch-assembly / compile /
+device for predicts; encode / decode for screens) — the same numbers
+recorded as ``di_request_*`` histograms in ``/metrics`` and, when a span
+sink is configured, as ``request_*`` events in ``events.jsonl`` under
+that ``trace_id``.
 * ``GET /metrics`` — the process-wide telemetry registry in Prometheus
   text format (``obs/expfmt.py``). Latency percentiles in ``/stats`` are
   derived from the same registry histogram the exposition serves, so the
@@ -53,6 +64,7 @@ import numpy as np
 from deepinteract_tpu.data.io import GRAPH_KEYS
 from deepinteract_tpu.obs import expfmt
 from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.obs.reqtrace import RequestTrace
 from deepinteract_tpu.robustness.preemption import PreemptionGuard
 from deepinteract_tpu.serving.engine import InferenceEngine
 from deepinteract_tpu.serving.scheduler import SchedulerClosed
@@ -169,6 +181,18 @@ class ServingServer:
             def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
                 logger.debug("http: " + fmt, *args)
 
+            def _route(self) -> str:
+                """Path sans query string (``/predict?trace=1`` is the
+                /predict route, for dispatch AND the metrics label)."""
+                return self.path.partition("?")[0]
+
+            def _trace_requested(self) -> bool:
+                from urllib.parse import parse_qs
+
+                query = self.path.partition("?")[2]
+                return parse_qs(query).get("trace", ["0"])[-1] in (
+                    "1", "true", "yes")
+
             def _send_body(self, code: int, body: bytes,
                            content_type: str) -> None:
                 # Counted BEFORE the body write: a client that disconnects
@@ -178,7 +202,7 @@ class ServingServer:
                 # is the matched route ("other" for 404s), not the raw
                 # path — unknown client paths must not mint unbounded
                 # label values in the registry.
-                endpoint = self.path if self.path in (
+                endpoint = self._route() if self._route() in (
                     "/predict", "/screen", "/healthz", "/stats",
                     "/metrics") else "other"
                 _REQUESTS.inc(endpoint=endpoint, status=str(code))
@@ -193,28 +217,30 @@ class ServingServer:
                                 "application/json")
 
             def do_GET(self):  # noqa: N802 - stdlib name
-                if self.path == "/healthz":
+                route = self._route()
+                if route == "/healthz":
                     self._send_json(200, {
                         "status": "draining" if server._draining.is_set()
                         else "ok",
                         "draining": server._draining.is_set(),
                     })
-                elif self.path == "/stats":
+                elif route == "/stats":
                     self._send_json(200, server.stats())
-                elif self.path == "/metrics":
+                elif route == "/metrics":
                     self._send_body(200, server.metrics_text().encode(),
                                     expfmt.CONTENT_TYPE)
                 else:
                     self._send_json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802 - stdlib name
-                if self.path not in ("/predict", "/screen"):
+                route = self._route()
+                if route not in ("/predict", "/screen"):
                     self._send_json(404, {"error": f"no route {self.path}"})
                     return
                 if server._draining.is_set():
                     self._send_json(503, {"error": "server is draining"})
                     return
-                if self.path == "/screen":
+                if route == "/screen":
                     self._do_screen()
                     return
                 try:
@@ -228,10 +254,15 @@ class ServingServer:
                 except Exception as exc:  # noqa: BLE001 - client error
                     self._send_json(400, {"error": str(exc)})
                     return
+                # Minted AFTER parse: the trace covers the request's trip
+                # through the scheduler/engine, the thing an operator
+                # debugs with it; upload decode time is in latency_ms.
+                reqtrace = RequestTrace("/predict")
                 t0 = time.monotonic()
                 try:
                     result = server.engine.predict(
-                        raw, timeout=server.request_timeout_s)
+                        raw, timeout=server.request_timeout_s,
+                        reqtrace=reqtrace)
                 except SchedulerClosed:
                     self._send_json(503, {"error": "server is draining"})
                     return
@@ -241,8 +272,9 @@ class ServingServer:
                     return
                 latency = time.monotonic() - t0
                 server.latency.record(latency)
-                self._send_json(200, {
+                response = {
                     "complex_name": raw.get("complex_name", ""),
+                    "trace_id": reqtrace.trace_id,
                     "n1": result["n1"],
                     "n2": result["n2"],
                     "bucket": list(result["bucket"]),
@@ -251,7 +283,10 @@ class ServingServer:
                     "latency_ms": latency * 1e3,
                     "contact_probs": np.asarray(
                         result["probs"], dtype=np.float64).tolist(),
-                })
+                }
+                if self._trace_requested() and "trace" in result:
+                    response["trace"] = result["trace"]
+                self._send_json(200, response)
 
             def _do_screen(self):
                 try:
@@ -262,9 +297,11 @@ class ServingServer:
                 except Exception as exc:  # noqa: BLE001 - client error
                     self._send_json(400, {"error": str(exc)})
                     return
+                reqtrace = RequestTrace("/screen")
                 t0 = time.monotonic()
                 try:
-                    out = server.run_screen(payload)
+                    out = server.run_screen(payload,
+                                            trace_id=reqtrace.trace_id)
                 except (ValueError, KeyError, FileNotFoundError,
                         OSError) as exc:
                     self._send_json(400, {"error": str(exc)})
@@ -274,6 +311,15 @@ class ServingServer:
                     self._send_json(500, {"error": str(exc)})
                     return
                 out["latency_ms"] = (time.monotonic() - t0) * 1e3
+                out["trace_id"] = reqtrace.trace_id
+                # A screen's device phases are its encode+decode wall
+                # (dispatches go straight to the device, no queue).
+                encode_s = out.get("encode_seconds", 0.0)
+                decode_s = out.get("decode_seconds", 0.0)
+                reqtrace.set_phase("device", encode_s + decode_s)
+                trace = reqtrace.finish(encode=encode_s, decode=decode_s)
+                if self._trace_requested():
+                    out["trace"] = trace
                 self._send_json(200, out)
 
         self.httpd = _QuietThreadingHTTPServer((host, port), Handler)
@@ -336,10 +382,11 @@ class ServingServer:
 
     # -- screening ---------------------------------------------------------
 
-    def run_screen(self, payload: Dict) -> Dict:
+    def run_screen(self, payload: Dict, trace_id: str = "") -> Dict:
         """Synchronous small screen for ``POST /screen`` (see module
         docstring). Raises ValueError/KeyError/OSError for client
-        mistakes (mapped to 400 by the handler)."""
+        mistakes (mapped to 400 by the handler). ``trace_id`` labels the
+        screen's ``screen_encode``/``screen_decode`` span events."""
         from deepinteract_tpu.screening import (
             ChainLibrary,
             EmbeddingCache,
@@ -373,7 +420,7 @@ class ServingServer:
                     top_k=int(payload.get("top_k", 10)),
                     decode_batch=self.engine.cfg.max_batch,
                     encode_batch=self.engine.cfg.max_batch))
-            result = runner.screen(library, pairs)
+            result = runner.screen(library, pairs, trace_id=trace_id)
         return {
             "chains": result.chains,
             "pairs": result.pairs_total,
@@ -387,7 +434,27 @@ class ServingServer:
         return {
             "engine": self.engine.stats(),
             "latency": self.latency.stats(),
+            "screening": self.screening_stats(),
             "draining": self._draining.is_set(),
+        }
+
+    def screening_stats(self) -> Dict[str, Any]:
+        """Operator view of the ``/screen`` route (invisible pre-PR-7):
+        answered-request counts read from the SAME registry counter the
+        exposition serves (agreement by construction), plus the shared
+        embedding cache's hit rate and occupancy."""
+        # NO _screen_lock here: run_screen holds it for an entire screen
+        # and /stats//metrics must not block behind in-flight device
+        # work. A bare attribute read is atomic, and EmbeddingCache.
+        # stats() takes the cache's own (short-held) lock.
+        cache = self._screen_cache
+        cache_stats = cache.stats() if cache is not None else {}
+        return {
+            "requests": _REQUESTS.value(endpoint="/screen", status="200"),
+            "requests_rejected": _REQUESTS.value(endpoint="/screen",
+                                                 status="400"),
+            "emb_cache_entries": int(cache_stats.get("size", 0)),
+            "emb_cache_hit_rate": float(cache_stats.get("hit_rate", 0.0)),
         }
 
     def metrics_text(self) -> str:
@@ -414,4 +481,11 @@ class ServingServer:
         g("di_serving_draining",
           "1 while the server refuses new work").set(
             float(self._draining.is_set()))
+        screening = self.screening_stats()
+        g("di_serving_screen_emb_cache_entries",
+          "Embeddings resident in the shared /screen cache").set(
+            screening["emb_cache_entries"])
+        g("di_serving_screen_emb_cache_hit_rate",
+          "Shared /screen embedding-cache hit rate since startup").set(
+            screening["emb_cache_hit_rate"])
         return expfmt.render()

@@ -1002,17 +1002,61 @@ class Trainer:
 
     def _stop_profile(self) -> None:
         """Idempotent capture stop (also the fit-end/abort safety net, so
-        a short run never leaves a trace capture dangling)."""
+        a short run never leaves a trace capture dangling). A completed
+        window is immediately attributed: per-dispatch device time from
+        the captured trace lands in the ``di_train_profile_*`` gauges and
+        the log, so the operator gets the first-order answer ("how much
+        of the step is device time, and which op leads") without leaving
+        the training console."""
         if self._profile_active:
             obs_spans.set_profiler_annotations(False)
             jax.profiler.stop_trace()
             self._profile_active = False
+            self._attribute_profile()
         if (self.cfg.profile_dir and not self._profile_started
                 and not self._profile_done):
             self.log(
                 f"profile_dir={self.cfg.profile_dir}: the run ended before "
                 "its second train dispatch — nothing was captured")
         self._profile_done = True
+
+    def _attribute_profile(self) -> None:
+        """Parse the just-captured --profile_dir trace into the device-
+        time gauges (best-effort: an exporter-format surprise must never
+        take down the run that just finished profiling)."""
+        try:
+            from deepinteract_tpu.obs import attribution as obs_attr
+            from deepinteract_tpu.obs import device as obs_device
+
+            trace = obs_device.load_profile(self.cfg.profile_dir)
+            agg = obs_attr.aggregate_ops(trace, top_n=3)
+            phases = obs_attr.attribute_phases(trace)["phases"]
+            dev_step = next((p for p in phases if p["name"] == "device_step"),
+                            None)
+            dispatches = (dev_step["instances"] if dev_step
+                          else max(1, self.cfg.profile_steps))
+            per_dispatch_ms = (dev_step["device_ms"] / dispatches
+                               if dev_step else
+                               agg["total_device_ms"] / dispatches)
+            obs_metrics.gauge(
+                "di_train_profile_device_seconds_per_dispatch",
+                "Measured device time per train dispatch over the last "
+                "--profile_dir window").set(per_dispatch_ms / 1e3)
+            obs_metrics.gauge(
+                "di_train_profile_device_total_seconds",
+                "Total device time inside the last --profile_dir "
+                "window").set(agg["total_device_ms"] / 1e3)
+            top = ", ".join(
+                f"{op['name']} {op['total_ms']:.2f}ms ({op['share']:.0%})"
+                for op in agg["top_ops"][:3])
+            self.log(
+                f"profile attribution: {agg['total_device_ms']:.2f} ms "
+                f"device time over {dispatches} dispatch(es) "
+                f"({per_dispatch_ms:.2f} ms/dispatch); top ops: {top}; "
+                f"full report: python -m deepinteract_tpu.cli.attribute "
+                f"--profile_dir {self.cfg.profile_dir}")
+        except Exception as exc:  # noqa: BLE001 - advisory only
+            self.log(f"profile attribution skipped: {exc}")
 
     def _run_train_epoch(self, state: TrainState, train_data: DataSource,
                          epoch: int, train_losses: list,

@@ -67,6 +67,49 @@ def test_bench_kind_stays_in_sync_with_dedicated_validator():
     assert tuple(CONTRACTS["bench"]["required"]) == tuple(REQUIRED_KEYS)
 
 
+def test_registered_kinds_cover_every_contract_cli():
+    """The keys-stay-in-sync roll call (ISSUE-8 satellite): every CLI
+    whose final line is a machine contract has a registered kind, so a
+    new entry point cannot silently ship without validator coverage."""
+    assert {"bench", "screen", "tune", "predict_topk", "attribution",
+            "perf_regression"} <= set(CONTRACTS)
+    for kind, spec in CONTRACTS.items():
+        assert set(spec["numeric"]) <= set(spec["required"]), kind
+
+
+def test_attribution_kind_matches_real_cli_emission(tmp_path, capsys):
+    """The attribution contract is validated against the REAL
+    cli.attribute run over the checked-in fixture trace (pure parsing —
+    no device, no compile)."""
+    from deepinteract_tpu.cli.attribute import main
+
+    fixtures = REPO / "tests" / "golden" / "attribution"
+    rc = main(["--profile_dir", str(fixtures / "host.trace.json.gz"),
+               "--census_json", str(fixtures / "census.json"),
+               "--out", str(tmp_path / "r.json")])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "attribution")
+    assert rec["unit"] == "ms" and rec["value"] > 0
+
+
+def test_perf_regression_kind_matches_real_tool_emission(tmp_path, capsys):
+    """Same discipline for the regression differ: validate its final
+    line via the registered kind, on both the ok and failing paths."""
+    from tools.check_perf_regression import main
+
+    contract = {"metric": "train_complexes_per_sec_b1_p128_scan8",
+                "value": 30.0, "unit": "complexes/s", "vs_baseline": 13.5}
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(contract))
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text("noise\n" + json.dumps(contract) + "\n")
+    assert main(["--fresh", str(fresh),
+                 "--baseline", str(baseline)]) == 0
+    rec = check_cli_contract_text(capsys.readouterr().out,
+                                  "perf_regression")
+    assert rec["ok"] is True and rec["unit"] == "regressions"
+
+
 def test_bench_headline_builder_passes_bench_kind():
     import bench
 

@@ -1,0 +1,158 @@
+"""tools/check_perf_regression.py: the bench-contract trajectory differ.
+
+Fixture contracts only (no bench run, no jax): the tests pin baseline
+resolution across the BENCH_r*.json artifact shapes, the per-key
+tolerance/direction rules, the plumbing-regression class (a perf key —
+or the whole contract line — going missing must fail loudly, the
+BENCH_r01/r05 ``"parsed": null`` mode), and the ``--update`` blessing.
+"""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.check_cli_contract import check_cli_contract_text  # noqa: E402
+from tools.check_perf_regression import (  # noqa: E402
+    compare,
+    main,
+    recover_contract,
+    resolve_baseline,
+)
+
+GOOD = {
+    "metric": "train_complexes_per_sec_b1_p128_scan8",
+    "value": 33.0, "unit": "complexes/s", "vs_baseline": 14.8,
+    "analytic_train_mfu": 0.052, "interaction_stem": "factorized",
+    "screening": {"screen_pairs_per_sec": 40.0, "speedup_vs_naive": 4.0},
+}
+
+
+def _capture(contract, noise="compile done\n"):
+    return noise + json.dumps(contract) + "\n"
+
+
+def _write_trajectory(root):
+    """BENCH_r01 (parsed null, recoverable tail) + BENCH_r02 (parsed)."""
+    older = dict(GOOD, value=20.0, vs_baseline=9.0)
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "rc": 0, "parsed": None,
+        "tail": _capture(older, noise="noise line\n")}))
+    (root / "BENCH_r02.json").write_text(json.dumps({
+        "n": 2, "rc": 0, "parsed": GOOD, "tail": "irrelevant"}))
+
+
+def test_recover_contract_from_all_artifact_shapes(tmp_path):
+    blessed = tmp_path / "blessed.json"
+    blessed.write_text(json.dumps(GOOD))
+    assert recover_contract(str(blessed))["value"] == 33.0
+    capture = tmp_path / "cap.log"
+    capture.write_text(_capture(GOOD))
+    assert recover_contract(str(capture))["value"] == 33.0
+    _write_trajectory(tmp_path)
+    assert recover_contract(str(tmp_path / "BENCH_r01.json"))["value"] == 20.0
+    assert recover_contract(str(tmp_path / "BENCH_r02.json"))["value"] == 33.0
+
+
+def test_resolve_baseline_prefers_blessed_then_newest_bench(tmp_path):
+    _write_trajectory(tmp_path)
+    contract, path = resolve_baseline(root=str(tmp_path))
+    assert path.endswith("BENCH_r02.json") and contract["value"] == 33.0
+    (tmp_path / "PERF_BASELINE.json").write_text(
+        json.dumps(dict(GOOD, value=31.0)))
+    contract, path = resolve_baseline(root=str(tmp_path))
+    assert path.endswith("PERF_BASELINE.json") and contract["value"] == 31.0
+    with pytest.raises(FileNotFoundError, match="no usable baseline"):
+        resolve_baseline(root=str(tmp_path / "empty"))
+
+
+def test_compare_tolerances_and_directions():
+    # Small drift inside the band: ok (and nothing reported).
+    verdict = compare(dict(GOOD, value=30.0), GOOD)
+    assert verdict["ok"] and not verdict["regressions"]
+    assert "value" in verdict["compared"]
+    # A >30% throughput DROP is a perf regression...
+    verdict = compare(dict(GOOD, value=20.0, vs_baseline=9.0), GOOD)
+    keys = {r["key"] for r in verdict["regressions"]}
+    assert not verdict["ok"] and {"value", "vs_baseline"} <= keys
+    # ...a >30% RISE is an improvement, never a failure.
+    verdict = compare(dict(GOOD, value=50.0, vs_baseline=22.4), GOOD)
+    assert verdict["ok"]
+    assert {i["key"] for i in verdict["improvements"]} == {
+        "value", "vs_baseline"}
+    # Nested screening keys compare flattened.
+    bad_screen = dict(GOOD, screening={"screen_pairs_per_sec": 10.0,
+                                       "speedup_vs_naive": 1.0})
+    verdict = compare(bad_screen, GOOD)
+    assert {"screening.screen_pairs_per_sec",
+            "screening.speedup_vs_naive"} <= {
+        r["key"] for r in verdict["regressions"]}
+
+
+def test_missing_perf_key_is_a_plumbing_regression():
+    """The generalized "parsed": null class: a key the baseline carried
+    that the fresh contract lost fails loudly, never silently passes."""
+    fresh = {k: v for k, v in GOOD.items() if k != "analytic_train_mfu"}
+    verdict = compare(fresh, GOOD)
+    (reg,) = [r for r in verdict["regressions"]]
+    assert reg["kind"] == "plumbing" and reg["key"] == "analytic_train_mfu"
+    assert not verdict["ok"]
+
+
+def test_identity_change_is_not_comparable():
+    verdict = compare(dict(GOOD, unit="pairs/s"), GOOD)
+    assert any(r["kind"] == "identity" and r["key"] == "unit"
+               for r in verdict["regressions"])
+
+
+def test_main_ok_and_regression_exit_codes(tmp_path, capsys, monkeypatch):
+    import tools.check_perf_regression as cpr
+
+    monkeypatch.setattr(cpr, "REPO_ROOT", str(tmp_path))
+    _write_trajectory(tmp_path)
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text(_capture(dict(GOOD, value=32.0)))
+    assert main(["--fresh", str(fresh)]) == 0
+    record = check_cli_contract_text(capsys.readouterr().out,
+                                     "perf_regression")
+    assert record["ok"] is True and record["compared"] >= 4
+
+    fresh.write_text(_capture(dict(GOOD, value=5.0, vs_baseline=2.2)))
+    assert main(["--fresh", str(fresh)]) == 1
+    record = check_cli_contract_text(capsys.readouterr().out,
+                                     "perf_regression")
+    assert record["ok"] is False and record["value"] >= 2
+
+
+def test_main_fails_loudly_on_unparseable_capture(tmp_path, capsys):
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text("a run that printed a detail dict last\nDETAIL {}\n")
+    assert main(["--fresh", str(fresh)]) == 1
+    out = capsys.readouterr()
+    record = check_cli_contract_text(out.out, "perf_regression")
+    assert record["ok"] is False
+    assert "no valid bench contract" in out.err
+
+
+def test_update_blesses_fresh_contract(tmp_path, capsys):
+    fresh = tmp_path / "fresh.log"
+    blessed = tmp_path / "PERF_BASELINE.json"
+    fresh.write_text(_capture(dict(GOOD, value=40.0)))
+    assert main(["--fresh", str(fresh), "--update",
+                 "--bless_to", str(blessed)]) == 0
+    capsys.readouterr()
+    assert json.loads(blessed.read_text())["value"] == 40.0
+    # The blessed file is now the baseline: the same numbers pass, a
+    # cliff against them fails.
+    fresh.write_text(_capture(dict(GOOD, value=39.0)))
+    assert main(["--fresh", str(fresh),
+                 "--baseline", str(blessed)]) == 0
+    capsys.readouterr()
+    fresh.write_text(_capture(dict(GOOD, value=10.0, vs_baseline=4.5)))
+    assert main(["--fresh", str(fresh),
+                 "--baseline", str(blessed)]) == 1
+    capsys.readouterr()

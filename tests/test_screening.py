@@ -445,13 +445,53 @@ def test_http_screen_route(engine, library, tmp_path):
         assert scores == sorted(scores, reverse=True)
         assert out["encode_reuse_ratio"] == pytest.approx(2 * 6 / 4)
         assert out["latency_ms"] > 0
+        # Request-scoped tracing: every screen answers with its trace_id.
+        assert len(out["trace_id"]) == 16
 
         # Second identical screen: embeddings served from the shared
-        # cache — zero encoder passes.
-        status, out2 = post({"npz_paths": paths, "top_k": 5})
+        # cache — zero encoder passes. ?trace=1 echoes the phase
+        # decomposition under a fresh trace_id.
+        def post_traced(body):
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            try:
+                conn.request("POST", "/screen?trace=1",
+                             body=json.dumps(body),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+
+        status, out2 = post_traced({"npz_paths": paths, "top_k": 5})
         assert status == 200
         assert out2["encodes_executed"] == 0
         assert out2["emb_cache_hit_rate"] > 0
+        assert out2["trace_id"] != out["trace_id"]
+        trace = out2["trace"]
+        assert trace["trace_id"] == out2["trace_id"]
+        assert trace["route"] == "/screen"
+        assert trace["device_ms"] == pytest.approx(
+            trace["encode_ms"] + trace["decode_ms"], abs=1e-6)
+        assert trace["total_ms"] > 0
+
+        # The /screen route is visible to operators: /stats gained a
+        # screening block whose request count reads the SAME registry
+        # counter /metrics exposes, and whose cache stats are the shared
+        # embedding cache's.
+        stats = srv.stats()
+        assert stats["screening"]["requests"] >= 2
+        assert stats["screening"]["emb_cache_entries"] == 4
+        assert stats["screening"]["emb_cache_hit_rate"] > 0
+        from tests.test_obs import parse_prometheus_text
+
+        samples = parse_prometheus_text(srv.metrics_text())
+        assert samples[("di_serving_screen_emb_cache_hit_rate",
+                        frozenset())] == pytest.approx(
+            stats["screening"]["emb_cache_hit_rate"])
+        assert samples[("di_serving_requests_total",
+                        frozenset([("endpoint", "/screen"),
+                                   ("status", "200")]))] == (
+            stats["screening"]["requests"])
 
         # Oversized screens are refused with guidance, not served.
         status, err = post({"npz_paths": paths, "include_self": True,

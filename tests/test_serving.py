@@ -451,6 +451,106 @@ def test_metrics_exposition_parses_and_agrees_with_stats(server):
     assert samples[("di_serving_flushes_total", frozenset())] >= 1
 
 
+def test_trace_id_propagates_scheduler_to_response_and_events(server,
+                                                              tmp_path):
+    """ISSUE-8 acceptance: a /predict with ?trace=1 answers with its
+    trace_id and a queue-wait/compile/device decomposition, and the SAME
+    numbers land as request_* span events in events.jsonl under that
+    trace_id — one id connects the response, the log, and the
+    histograms."""
+    from deepinteract_tpu.obs import spans as obs_spans
+    from deepinteract_tpu.obs.spans import read_events
+
+    srv, _, _, _ = server
+    host, port = srv.address
+    sink = str(tmp_path / "events.jsonl")
+    obs_spans.configure(sink)
+    try:
+        raw = fresh_raw(470)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "c.npz")
+            save_complex_npz(path, raw["graph1"], raw["graph2"],
+                             raw["examples"], "c")
+            with open(path, "rb") as fh:
+                body = fh.read()
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        try:
+            conn.request("POST", "/predict?trace=1", body=body,
+                         headers={"Content-Type":
+                                  "application/octet-stream"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            out = json.loads(resp.read())
+        finally:
+            conn.close()
+        trace = out["trace"]
+        assert out["trace_id"] == trace["trace_id"]
+        assert len(out["trace_id"]) == 16
+        assert trace["route"] == "/predict" and not trace["cached"]
+        # The decomposition's parts fit inside its total (assembly/
+        # compile/device are batch-shared; queue_wait is the request's
+        # own).
+        parts = (trace["queue_wait_ms"] + trace["batch_assembly_ms"]
+                 + trace["compile_ms"] + trace["device_ms"])
+        assert 0 < parts <= trace["total_ms"] * 1.05
+        assert trace["device_ms"] > 0  # a real dispatch happened
+    finally:
+        obs_spans.close()
+    events = {e["name"]: e for e in read_events(sink)
+              if e.get("trace_id") == out["trace_id"]}
+    assert set(events) == {"request", "request_queue_wait",
+                           "request_batch_assembly", "request_compile",
+                           "request_device"}
+    for phase in ("queue_wait", "batch_assembly", "compile", "device"):
+        assert events[f"request_{phase}"]["dur_s"] * 1e3 == pytest.approx(
+            trace[f"{phase}_ms"], abs=0.01)
+    assert events["request"]["coalesced"] == trace["coalesced"]
+    # A plain request (no ?trace=1) still answers with its trace_id but
+    # no decomposition block; a cached repeat mints a FRESH trace_id.
+    status, out2 = _post_npz(host, port, raw)
+    assert status == 200 and "trace" not in out2
+    assert len(out2["trace_id"]) == 16
+    assert out2["trace_id"] != out["trace_id"] and out2["cached"]
+
+
+def test_engine_reqtrace_direct_and_cached_paths(engine):
+    """Engine-level contract (no HTTP): a traced predict returns the
+    decomposition; a result-cache hit returns an all-zero one flagged
+    cached."""
+    from deepinteract_tpu.obs.reqtrace import RequestTrace
+
+    raw = fresh_raw(480)
+    first = engine.predict(raw, reqtrace=RequestTrace("/predict"))
+    assert not first["trace"]["cached"]
+    assert first["trace"]["device_ms"] > 0
+    assert first["trace"]["queue_wait_ms"] >= 0
+    hit = engine.predict(raw, reqtrace=RequestTrace("/predict"))
+    assert hit["cached"] and hit["trace"]["cached"]
+    assert hit["trace"]["device_ms"] == 0.0
+    assert hit["trace"]["trace_id"] != first["trace"]["trace_id"]
+    # Untraced callers see no trace key at all (zero overhead).
+    plain = engine.predict(fresh_raw(481))
+    assert "trace" not in plain
+
+
+def test_request_histograms_in_metrics(server):
+    """The di_request_* histograms back the decomposition in /metrics:
+    after the traced predicts above, every phase family carries samples
+    for the /predict route."""
+    from tests.test_obs import parse_prometheus_text
+
+    srv, _, _, _ = server
+    samples = parse_prometheus_text(srv.metrics_text())
+    for family in ("di_request_queue_wait_seconds",
+                   "di_request_batch_assembly_seconds",
+                   "di_request_compile_seconds",
+                   "di_request_device_seconds",
+                   "di_request_total_seconds"):
+        count = samples[(f"{family}_count",
+                         frozenset([("route", "/predict")]))]
+        assert count >= 1, family
+
+
 def test_sigterm_drain_completes_inflight_then_refuses(server):
     """PR-1 preemption discipline over the serving stack: a drain request
     (the SIGTERM handler's effect) finishes queued work, answers it, then

@@ -46,6 +46,17 @@ CONTRACTS = {
                      "top_contacts_out"),
         "numeric": ("value", "top_k"),
     },
+    "attribution": {
+        "required": ("metric", "value", "unit", "profile_dir",
+                     "report_out", "op_launches", "top_ops", "phases",
+                     "census_reconciled"),
+        "numeric": ("value", "op_launches"),
+    },
+    "perf_regression": {
+        "required": ("metric", "value", "unit", "ok", "baseline",
+                     "compared", "regressions"),
+        "numeric": ("value", "compared"),
+    },
 }
 
 

@@ -1,0 +1,262 @@
+"""Diff a fresh bench contract line against the committed trajectory.
+
+The BENCH_r*.json artifacts record each round's bench capture, but
+nothing ever *compared* consecutive rounds — a silent throughput cliff
+(or the BENCH_r01/r05 ``"parsed": null`` plumbing failure, where the
+run finished but the contract line was unparseable) only surfaced when
+a human re-read the numbers. This tool makes the comparison a command::
+
+    python bench.py | tee bench.log
+    python tools/check_perf_regression.py --fresh bench.log
+
+    # bless an intentional change as the new baseline
+    python tools/check_perf_regression.py --fresh bench.log --update
+
+Baseline resolution order: ``--baseline PATH`` > ``PERF_BASELINE.json``
+(the blessed file ``--update`` writes) > the newest ``BENCH_r*.json``
+whose contract is recoverable (its ``parsed`` field, else the final
+JSON line of its ``tail`` capture).
+
+Per-key tolerances are relative and direction-aware (throughput keys
+regress only when they DROP; byte keys only when they GROW). A key the
+baseline carried that the fresh contract lost is a plumbing regression
+and fails loudly — that is the ``"parsed": null`` class generalized to
+individual keys.
+
+The FINAL stdout line is a machine-readable JSON contract
+(tools/check_cli_contract.py, kind ``perf_regression``). Exit 0 = no
+regression, 1 = regression or incomparable capture, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.check_cli_contract import (  # noqa: E402
+    check_cli_contract_text,
+    final_json_line,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BLESSED_BASENAME = "PERF_BASELINE.json"
+
+# key -> (relative tolerance, direction): +1 keys are higher-better
+# (regression = drop below baseline*(1-tol)); -1 keys are lower-better
+# (regression = growth above baseline*(1+tol)). Only listed keys gate;
+# everything else in the contract is provenance, not a perf number.
+TOLERANCES = {
+    "value": (0.30, +1),
+    "vs_baseline": (0.30, +1),
+    "analytic_train_mfu": (0.30, +1),
+    "train_step_complexes_per_sec_b1_p128": (0.30, +1),
+    "train_scan_complexes_per_sec_min_sample": (0.35, +1),
+    "interaction_bytes": (0.05, -1),
+    "screening.screen_pairs_per_sec": (0.35, +1),
+    "screening.naive_pairs_per_sec": (0.35, +1),
+    "screening.speedup_vs_naive": (0.35, +1),
+    "screening.encode_reuse_ratio": (0.10, +1),
+    "attribution.total_device_ms": (0.50, -1),
+}
+# Keys whose values must match exactly for the runs to be comparable at
+# all (a different metric/unit is a different experiment, not a drift).
+IDENTITY_KEYS = ("metric", "unit")
+
+
+def _flatten(record: dict, prefix: str = "") -> dict:
+    """One level of nesting ("screening.screen_pairs_per_sec") is enough
+    for the contract's shape."""
+    flat = {}
+    for key, val in record.items():
+        name = f"{prefix}{key}"
+        if isinstance(val, dict):
+            flat.update(_flatten(val, prefix=f"{name}."))
+        else:
+            flat[name] = val
+    return flat
+
+
+def recover_contract(path: str) -> dict:
+    """A baseline file -> its bench contract dict. Accepts a blessed
+    contract (``--update`` output), a driver BENCH_r capture (``parsed``
+    field, else the final JSON line of ``tail``), or a raw stdout log."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        blob = json.loads(text)
+    except json.JSONDecodeError:
+        return check_cli_contract_text(text, "bench")  # raw capture log
+    if isinstance(blob, dict) and "metric" in blob and "value" in blob:
+        return blob  # blessed contract
+    if isinstance(blob, dict) and "tail" in blob:
+        if isinstance(blob.get("parsed"), dict):
+            return blob["parsed"]
+        return check_cli_contract_text(blob["tail"], "bench")
+    raise ValueError(f"{path}: not a bench contract, capture, or "
+                     "BENCH_r artifact")
+
+
+def resolve_baseline(explicit: str = "", root: str = ""):
+    """(contract, path) per the resolution order in the module doc."""
+    root = root or REPO_ROOT  # read at call time (tests repoint it)
+    if explicit:
+        return recover_contract(explicit), explicit
+    blessed = os.path.join(root, BLESSED_BASENAME)
+    if os.path.exists(blessed):
+        return recover_contract(blessed), blessed
+    candidates = sorted(
+        glob.glob(os.path.join(root, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)),
+        reverse=True)
+    errors = []
+    for path in candidates:
+        try:
+            return recover_contract(path), path
+        except (ValueError, json.JSONDecodeError) as exc:
+            errors.append(f"{os.path.basename(path)}: {exc}")
+    raise FileNotFoundError(
+        "no usable baseline: no --baseline, no "
+        f"{BLESSED_BASENAME}, and no BENCH_r*.json with a recoverable "
+        f"contract ({'; '.join(errors) or 'none found'})")
+
+
+def compare(fresh: dict, baseline: dict) -> dict:
+    """The diff verdict: regressions / improvements / missing keys."""
+    flat_fresh = _flatten(fresh)
+    flat_base = _flatten(baseline)
+    regressions, improvements, missing, compared = [], [], [], []
+    for key in IDENTITY_KEYS:
+        if key in flat_base and flat_fresh.get(key) != flat_base[key]:
+            regressions.append({
+                "key": key, "kind": "identity",
+                "baseline": flat_base[key], "fresh": flat_fresh.get(key),
+                "detail": "contract identity changed — runs are not "
+                          "comparable (use --update to bless)",
+            })
+    for key, (tol, direction) in TOLERANCES.items():
+        if key not in flat_base:
+            continue
+        base_val = flat_base[key]
+        if not isinstance(base_val, (int, float)) or isinstance(
+                base_val, bool):
+            continue
+        if key not in flat_fresh or not isinstance(
+                flat_fresh[key], (int, float)) or isinstance(
+                flat_fresh[key], bool):
+            missing.append(key)
+            continue
+        new_val = float(flat_fresh[key])
+        compared.append(key)
+        if base_val == 0:
+            continue
+        rel = (new_val - float(base_val)) / abs(float(base_val))
+        worse = -rel if direction > 0 else rel
+        entry = {"key": key, "baseline": base_val, "fresh": new_val,
+                 "rel_change": round(rel, 4), "tolerance": tol}
+        if worse > tol:
+            regressions.append(dict(entry, kind="perf"))
+        elif -worse > tol:
+            improvements.append(entry)
+    for key in missing:
+        regressions.append({
+            "key": key, "kind": "plumbing",
+            "baseline": flat_base[key], "fresh": None,
+            "detail": "baseline carried this perf key; the fresh "
+                      "contract lost it (the \"parsed\": null class)",
+        })
+    notes = []
+    if not compared and not regressions:
+        notes.append("no overlapping perf keys with the baseline (old "
+                     "artifact format?) — nothing was actually compared; "
+                     "bless a fresh baseline with --update")
+    if fresh.get("partial"):
+        notes.append("fresh capture is partial (sections "
+                     "skipped/errored) — absolute numbers may be thin")
+    return {"regressions": regressions, "improvements": improvements,
+            "compared": compared, "notes": notes,
+            "ok": not regressions}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fresh", default="-",
+                        help="fresh bench stdout capture (file or '-')")
+    parser.add_argument("--baseline", default="",
+                        help="explicit baseline (blessed contract, "
+                             "BENCH_r artifact, or capture log)")
+    parser.add_argument("--update", action="store_true",
+                        help="bless the fresh contract as the new "
+                             "baseline (PERF_BASELINE.json)")
+    parser.add_argument("--bless_to", default="",
+                        help="where --update writes (default repo-root "
+                             f"{BLESSED_BASENAME})")
+    args = parser.parse_args(argv)
+
+    if args.fresh == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.fresh) as fh:
+            text = fh.read()
+    try:
+        fresh = check_cli_contract_text(text, "bench")
+    except ValueError as exc:
+        print(f"PERF REGRESSION CHECK FAILED: fresh capture has no valid "
+              f"bench contract line: {exc}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "perf_regression", "value": 1.0, "unit": "regressions",
+            "ok": False, "baseline": None, "compared": 0,
+            "regressions": [{"key": "<contract>", "kind": "plumbing",
+                             "detail": str(exc)}]}))
+        return 1
+
+    if args.update:
+        out = args.bless_to or os.path.join(REPO_ROOT, BLESSED_BASENAME)
+        tmp = out + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(fresh, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, out)
+        print(f"blessed fresh contract -> {out}")
+        print(json.dumps({
+            "metric": "perf_regression", "value": 0.0, "unit": "regressions",
+            "ok": True, "baseline": out, "compared": 0,
+            "regressions": [], "blessed": True}))
+        return 0
+
+    try:
+        baseline, baseline_path = resolve_baseline(args.baseline)
+    except (FileNotFoundError, ValueError) as exc:
+        print(f"PERF REGRESSION CHECK FAILED: {exc}", file=sys.stderr)
+        return 2
+
+    verdict = compare(fresh, baseline)
+    for reg in verdict["regressions"]:
+        print(f"REGRESSION [{reg['kind']}] {reg['key']}: "
+              f"{reg.get('baseline')} -> {reg.get('fresh')} "
+              f"({reg.get('detail', reg.get('rel_change'))})",
+              file=sys.stderr)
+    for imp in verdict["improvements"]:
+        print(f"improvement {imp['key']}: {imp['baseline']} -> "
+              f"{imp['fresh']} ({imp['rel_change']:+.1%})")
+    print(json.dumps({
+        "metric": "perf_regression",
+        "value": float(len(verdict["regressions"])),
+        "unit": "regressions",
+        "ok": verdict["ok"],
+        "baseline": baseline_path,
+        "compared": len(verdict["compared"]),
+        "regressions": verdict["regressions"],
+        "improvements": verdict["improvements"],
+        "notes": verdict["notes"],
+    }))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,12 @@
 """Compiled-HLO launch census for the decoder: where do the kernels go?
 
-Compiles the full decoder forward (masked, depad) and the mask=None
-variant for the real TPU backend and prints per-opcode top-level op
-counts of the optimized HLO entry computation — the number of kernel
-launches XLA actually schedules. The masked-vs-unmasked launch delta
-localizes the ~3.3 ms gap measured by tools/decoder_ablation.py better
-than micro-benchmarks can.
+Thin CLI shim over :mod:`deepinteract_tpu.obs.hloquery` (the census
+moved there so the attribution layer — ``obs/attribution.py`` /
+``cli/attribute.py`` — can join launch *counts* against measured per-op
+*time*). Compiles the full decoder forward (masked, depad) and the
+mask=None variant for the current backend and prints per-opcode
+top-level op counts of the optimized HLO entry computation, plus the
+biggest inner computations (the scan body is where the chunks live).
 
 Usage: python tools/hlo_probe.py [pad]
 """
@@ -13,35 +14,23 @@ Usage: python tools/hlo_probe.py [pad]
 from __future__ import annotations
 
 import os
-import re
 import sys
-from collections import Counter
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from deepinteract_tpu.obs.hloquery import (  # noqa: E402
+    entry_census,
+    top_computations,
+)
 
-def census(txt: str) -> Counter:
-    """Opcode counts of the ENTRY computation's top-level ops."""
-    counts: Counter = Counter()
-    in_entry = False
-    for line in txt.splitlines():
-        if line.startswith("ENTRY "):
-            in_entry = True
-            continue
-        if in_entry:
-            if line.startswith("}"):
-                break
-            m = re.match(r"\s+\S+ = \S+ ([a-z0-9\-]+)[.(]", line)
-            if m:
-                counts[m.group(1)] += 1
-    return counts
+# Back-compat alias: the census used to be defined here.
+census = entry_census
 
 
 def main() -> int:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
 
@@ -55,36 +44,17 @@ def main() -> int:
     model = InteractionDecoder(DecoderConfig())
     variables = model.init(jax.random.PRNGKey(0), x, mask)
 
-    results = {}
     for name, m in (("masked", mask), ("no-mask", None)):
         compiled = jax.jit(
             lambda v, xx, mm=m: model.apply(v, xx, mm)
         ).lower(variables, x).compile()
         txt = compiled.as_text()
-        c = census(txt)
-        results[name] = c
+        c = entry_census(txt)
         total = sum(c.values())
         print(f"\n{name}: {total} top-level entry ops")
         for op, n in c.most_common(12):
             print(f"  {op:24s} {n}")
-        # Per-computation census: the scan body is where the 14 chunks live.
-        comps = {}
-        cur = None
-        for line in txt.splitlines():
-            m = re.match(r"(?:ENTRY )?%?([\w.\-]+)[ ]*\([^)]*\) -> ", line)
-            if m:
-                cur = m.group(1)
-                comps[cur] = Counter()
-                continue
-            if cur is not None:
-                if line.startswith("}"):
-                    cur = None
-                    continue
-                m2 = re.match(r"\s+\S+ = \S+ ([a-z0-9\-]+)[.(]", line)
-                if m2:
-                    comps[cur][m2.group(1)] += 1
-        big = sorted(comps.items(), key=lambda kv: -sum(kv[1].values()))[:4]
-        for cname, cc in big:
+        for cname, cc in top_computations(txt, n=4):
             interesting = {k: v for k, v in cc.most_common(8)}
             print(f"  comp {cname[:40]:40s} {sum(cc.values()):4d} ops "
                   f"{interesting}")

@@ -1,0 +1,114 @@
+"""Regenerate the checked-in attribution fixture (tests/golden/attribution/).
+
+The fixture is one CPU-profiler capture of the REAL interaction decoder
+(masked forward, three ``device_step``-annotated executions) plus the
+artifacts the attribution tests reconcile against:
+
+* ``host.trace.json.gz``       — the jax.profiler trace-event file (renamed
+                            from the capture's ``plugins/profile/...``
+                            layout; the parser accepts bare files);
+* ``events.jsonl``        — the PR-3 span log written DURING the same
+                            capture (the phase-wall cross-check source);
+* ``census.json``         — ``{"census": {...}, "meta": {...}}`` from
+                            the same compiled executable's HLO entry
+                            computation (obs/hloquery.py).
+
+Tests only parse these files — regeneration (this script) is the only
+step that needs a compile. Deterministic inputs; the timings inside are
+whatever this machine measured, and tests assert structure + internal
+consistency, never absolute times.
+
+Usage: JAX_PLATFORMS=cpu python tools/make_attribution_fixture.py
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "golden", "attribution")
+PAD = 48
+STEPS = 3
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepinteract_tpu.models.decoder import DecoderConfig, InteractionDecoder
+    from deepinteract_tpu.obs import device as obs_device
+    from deepinteract_tpu.obs import hloquery
+    from deepinteract_tpu.obs import spans as obs_spans
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    events_path = os.path.join(OUT_DIR, "events.jsonl")
+    if os.path.exists(events_path):
+        os.unlink(events_path)
+    obs_spans.configure(events_path)
+
+    rng = np.random.default_rng(0)
+    # 4 chunks / 32 channels: the same masked bottleneck structure (and
+    # the same re-mask select chain) as the flagship 14-chunk decoder at
+    # a fraction of the trace size — the fixture is checked into git.
+    cfg = DecoderConfig(num_chunks=4, num_channels=32)
+    x = jnp.asarray(
+        rng.standard_normal((1, PAD, PAD, cfg.in_channels)).astype(np.float32))
+    mask_np = np.zeros((1, PAD, PAD), bool)
+    mask_np[:, : PAD - 8, : PAD - 12] = True
+    mask = jnp.asarray(mask_np)
+    model = InteractionDecoder(cfg)
+    variables = model.init(jax.random.PRNGKey(0), x, mask)
+    compiled = jax.jit(
+        lambda v, xx: model.apply(v, xx, mask)
+    ).lower(variables, x).compile()
+    compiled(variables, x)[0].block_until_ready()  # warm outside capture
+
+    capture_dir = os.path.join(OUT_DIR, "_capture")
+    shutil.rmtree(capture_dir, ignore_errors=True)
+    with obs_device.capture(capture_dir):
+        for i in range(STEPS):
+            with obs_spans.span("device_step", step_num=i):
+                np.asarray(compiled(variables, x))
+    obs_spans.close()
+
+    files = glob.glob(os.path.join(capture_dir, "**", "*.trace.json*"),
+                      recursive=True)
+    assert files, "capture produced no trace file"
+    src = files[0]
+    dst = os.path.join(OUT_DIR, "host.trace.json.gz")
+    if src.endswith(".gz"):
+        shutil.copyfile(src, dst)
+    else:
+        with open(src, "rb") as fin, gzip.open(dst, "wb") as fout:
+            shutil.copyfileobj(fin, fout)
+    shutil.rmtree(capture_dir, ignore_errors=True)
+
+    census = hloquery.census_compiled(compiled)
+    meta = {
+        "device": jax.devices()[0].device_kind,
+        "platform": jax.devices()[0].platform,
+        "pad": PAD, "masked": True, "steps": STEPS,
+        "source": "decoder_forward_fixture",
+        "jax_version": jax.__version__,
+    }
+    with open(os.path.join(OUT_DIR, "census.json"), "w") as fh:
+        json.dump({"census": dict(census), "meta": meta}, fh, indent=2,
+                  sort_keys=True)
+
+    trace = obs_device.load_profile(dst, phase_names=("device_step",))
+    print(f"fixture written to {OUT_DIR}: {len(trace.ops)} op events, "
+          f"{len(trace.phases)} device_step windows, "
+          f"{sum(census.values())} census launches")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
