@@ -1,0 +1,47 @@
+"""Unified static-analysis subsystem: one AST rule engine, many detectors.
+
+The repo grew its static checks one script at a time (``tools/check_*``:
+no-print, dtype discipline, CLI-contract, perf-regression) — each with
+its own walker, its own exit-code convention, and no way to suppress or
+baseline a finding. This package is the consolidation: a rule registry
+over a shared parsed-file cache, per-finding ``# di: allow[rule]``
+suppression pragmas, and a checked-in ``LINT_BASELINE.json`` so
+pre-existing findings don't block CI while NEW ones fail loudly.
+
+Entry point::
+
+    python -m deepinteract_tpu.cli.lint            # all rules, repo-wide
+    python -m deepinteract_tpu.cli.lint --rules jit-host-sync
+    python -m deepinteract_tpu.cli.lint --update_baseline
+
+The final stdout line is a machine-readable ``lint/v1`` contract
+(validated by ``tools/check_cli_contract.py lint``); the run is wired
+into tier-1 as ``tests/test_lint.py``.
+
+Rule catalog (see each module's docstring for the precise semantics):
+
+* ``no-print`` — no bare ``print()`` outside ``cli/`` (migrated from
+  ``tools/check_no_print.py``, which remains as a thin shim);
+* ``dtype-discipline`` — no hardcoded float dtypes in ``models/``
+  outside ``policy.py`` (migrated from
+  ``tools/check_dtype_discipline.py``, shim kept);
+* ``jit-host-sync`` — host syncs (``.item()``, ``float()``,
+  ``np.asarray``, branching on traced values) inside functions reachable
+  from ``jax.jit``/``pjit``/``lax.scan``/``remat``;
+* ``lock-discipline`` — attributes guarded by a class's
+  ``threading.Lock`` in one method but accessed bare in another;
+* ``prng-key-reuse`` — a ``jax.random`` key consumed twice without an
+  intervening ``split``;
+* ``dead-cli-flag`` — flags registered in ``cli/args.py`` whose dest is
+  never read.
+"""
+
+from deepinteract_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Rule,
+    SourceFile,
+    all_rules,
+    get_rule,
+    register,
+)
+from deepinteract_tpu.analysis.runner import load_files, run_rules  # noqa: F401

@@ -1,0 +1,95 @@
+"""Checked-in finding baseline: pre-existing debt doesn't block, new debt fails.
+
+``LINT_BASELINE.json`` (repo root) holds the fingerprints of findings that
+were present — and consciously accepted — when a rule landed. The lint
+run classifies every unsuppressed finding as *baselined* (fingerprint in
+the file) or *new* (fails the run), and reports baseline entries that no
+longer match anything as *stale* so the file shrinks as debt is paid.
+
+``--update_baseline`` rewrites the file from the current run. The
+workflow for a rule change or an accepted finding::
+
+    python -m deepinteract_tpu.cli.lint                  # see what's new
+    # fix it, or # di: allow[rule] it with a reason, or:
+    python -m deepinteract_tpu.cli.lint --update_baseline
+
+The file is sorted and keyed by fingerprint with the human-readable
+location alongside, so diffs in review show WHAT was accepted, not just
+that something was.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Dict, List, Sequence, Tuple
+
+from deepinteract_tpu.analysis.core import Finding
+
+SCHEMA_VERSION = 1
+DEFAULT_BASELINE_NAME = "LINT_BASELINE.json"
+
+
+def load(path: pathlib.Path) -> Dict[str, dict]:
+    """fingerprint -> entry dict. A missing file is an empty baseline; a
+    wrong schema version fails loudly (a silently ignored baseline would
+    re-fail every accepted finding)."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if data.get("schema_version") != SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: baseline schema_version "
+            f"{data.get('schema_version')!r} != {SCHEMA_VERSION} — "
+            "regenerate with --update_baseline")
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save(path: pathlib.Path,
+         fingerprinted: Sequence[Tuple[Finding, str]],
+         keep_entries: Sequence[dict] = ()) -> None:
+    """Write the baseline. ``keep_entries`` carries existing entries that
+    this run did NOT re-evaluate (a ``--rules`` subset run must not wipe
+    the other rules' accepted debt)."""
+    entries = [
+        {
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,  # informational; identity is the fingerprint
+            "message": f.message,
+        }
+        for f, fp in sorted(fingerprinted,
+                            key=lambda t: (t[0].path, t[0].line, t[0].rule))
+    ]
+    known = {e["fingerprint"] for e in entries}
+    entries.extend(e for e in keep_entries
+                   if e["fingerprint"] not in known)
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "comment": ("Accepted pre-existing lint findings "
+                    "(python -m deepinteract_tpu.cli.lint "
+                    "--update_baseline). New findings fail the run."),
+        "findings": entries,
+    }
+    path.write_text(json.dumps(payload, indent=1) + "\n", encoding="utf-8")
+
+
+def classify(
+    fingerprinted: Sequence[Tuple[Finding, str]],
+    baseline: Dict[str, dict],
+) -> Tuple[List[Tuple[Finding, str]], List[Tuple[Finding, str]], List[dict]]:
+    """(new, baselined, stale_entries). ``fingerprinted`` must be the
+    UNSUPPRESSED findings only — a suppressed finding neither consumes
+    nor invalidates a baseline entry."""
+    new, matched = [], []
+    seen = set()
+    for f, fp in fingerprinted:
+        if fp in baseline:
+            matched.append((f, fp))
+            seen.add(fp)
+        else:
+            new.append((f, fp))
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+    return new, matched, stale

@@ -1,0 +1,181 @@
+"""Rule engine core: findings, the rule registry, suppression pragmas.
+
+Design decisions that every rule inherits:
+
+* **One parse per file.** Rules receive :class:`SourceFile` objects whose
+  AST is parsed once by the runner — six rules over ~100 files stay a
+  single-process, sub-second run.
+* **Line-content fingerprints, not line numbers.** A finding's baseline
+  identity is ``sha1(rule | relpath | stripped source line | occurrence
+  index)`` — editing an unrelated part of the file moves line numbers but
+  not fingerprints, so the checked-in baseline doesn't churn.
+* **Suppression is per-finding and named.** ``# di: allow[rule]`` on the
+  flagged line (or the line directly above, for long statements) waives
+  exactly that rule there; the pragma text is expected to carry a one-line
+  reason, and suppressed findings are still counted in the report so an
+  over-suppressed file is visible.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import pathlib
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+# ``# di: allow[rule-a,rule-b] optional reason`` — the pragma grammar.
+_PRAGMA_RE = re.compile(r"#\s*di:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    line: int  # 1-indexed
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+class SourceFile:
+    """A parsed repo file: path, text, AST, and per-line pragma map."""
+
+    def __init__(self, root: pathlib.Path, path: pathlib.Path):
+        self.abspath = path
+        self.path = path.relative_to(root).as_posix()
+        self.parse_error: Optional[SyntaxError] = None
+        self.tree: Optional[ast.AST] = None
+        try:
+            self.text = path.read_text(encoding="utf-8")
+        except (UnicodeDecodeError, OSError) as exc:
+            # Surfaced as a per-file parse failure (same path as a
+            # SyntaxError) — one bad file must not kill the whole run
+            # before the contract line.
+            self.text = ""
+            err = SyntaxError(f"unreadable: {exc}")
+            err.lineno = 0
+            self.parse_error = err
+        self.lines = self.text.splitlines()
+        if self.parse_error is None:
+            try:
+                self.tree = ast.parse(self.text, filename=str(path))
+            except SyntaxError as exc:
+                self.parse_error = exc
+        self._allowed: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                self._allowed[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """True when the flagged line — or the line directly above it —
+        carries ``# di: allow[<rule>]`` (or ``allow[all]``)."""
+        for ln in (line, line - 1):
+            allowed = self._allowed.get(ln)
+            if allowed and (rule in allowed or "all" in allowed):
+                return True
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """A registered detector.
+
+    ``check`` sees the FULL file list (cross-file rules like
+    ``dead-cli-flag`` need it) and yields findings; ``scope`` prunes which
+    files a per-file rule reports on, but the full list is always passed
+    so a rule may consult out-of-scope files for context.
+    """
+
+    name: str
+    help: str
+    check: Callable[[Sequence[SourceFile]], Iterable[Finding]]
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(name: str, help: str):
+    """Decorator: ``@register("rule-name", "one-line description")`` over
+    a ``check(files) -> Iterable[Finding]`` function. Idempotent per name
+    (module re-import must not duplicate), conflicting re-registration
+    raises."""
+
+    def deco(fn):
+        existing = _RULES.get(name)
+        if existing is not None and existing.check is not fn:
+            raise ValueError(f"rule {name!r} is already registered")
+        _RULES[name] = Rule(name=name, help=help, check=fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    # Importing the rules package populates the registry; do it lazily so
+    # ``core`` has no import cycle with the rule modules.
+    import deepinteract_tpu.analysis.rules  # noqa: F401
+
+    return [_RULES[n] for n in sorted(_RULES)]
+
+
+def get_rule(name: str) -> Rule:
+    import deepinteract_tpu.analysis.rules  # noqa: F401
+
+    if name not in _RULES:
+        raise KeyError(
+            f"unknown rule {name!r} (registered: {sorted(_RULES)})")
+    return _RULES[name]
+
+
+def dotted_name(node: ast.expr) -> Optional[tuple]:
+    """('jax', 'lax', 'scan') for a ``jax.lax.scan`` attribute chain
+    rooted at a Name; None for anything else (calls, subscripts,
+    literals). Shared by every rule that resolves call targets."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def fingerprint(finding: Finding, line_text: str, occurrence: int) -> str:
+    """Stable identity of a finding for the baseline: rule + path + the
+    flagged line's stripped TEXT (not its number) + the occurrence index
+    among identical (rule, path, text) triples."""
+    payload = f"{finding.rule}|{finding.path}|{line_text}|{occurrence}"
+    return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+
+def assign_fingerprints(
+    findings: Sequence[Finding], files_by_path: Dict[str, SourceFile]
+) -> List[tuple]:
+    """(finding, fingerprint) pairs with per-duplicate occurrence
+    numbering, ordered by (path, line, rule)."""
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    seen: Dict[tuple, int] = {}
+    out = []
+    for f in ordered:
+        sf = files_by_path.get(f.path)
+        text = sf.line_text(f.line) if sf is not None else ""
+        key = (f.rule, f.path, text)
+        occurrence = seen.get(key, 0)
+        seen[key] = occurrence + 1
+        out.append((f, fingerprint(f, text, occurrence)))
+    return out

@@ -1,0 +1,10 @@
+"""Rule modules — importing this package populates the registry."""
+
+from deepinteract_tpu.analysis.rules import (  # noqa: F401
+    dead_cli_flag,
+    dtype_discipline,
+    jit_host_sync,
+    lock_discipline,
+    no_print,
+    prng_reuse,
+)

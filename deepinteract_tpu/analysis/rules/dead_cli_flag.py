@@ -1,0 +1,132 @@
+"""Rule ``dead-cli-flag``: registered flags whose dest is never read.
+
+``cli/args.py`` is the shared argument surface for every entry point; a
+flag that parses but is read nowhere is worse than missing — the operator
+types it, gets no error, and silently doesn't get the behavior. This
+rule cross-references every ``add_argument("--name", ...)`` registration
+against attribute reads of its dest anywhere in the scanned tree.
+
+A "read" is counted conservatively, so false positives stay rare:
+
+* any attribute access ``<obj>.<dest>`` with a matching attribute name —
+  the args namespace travels under many local names (``args``, ``a``,
+  partially unpacked), and a same-named dataclass field being read also
+  proves the NAME is load-bearing;
+* ``getattr(x, "<dest>"[, default])`` with the dest as a string constant.
+
+Registrations inside ``add_argument`` calls themselves never count, and
+``dest=`` overrides are honored. The flag's finding anchors at its
+``add_argument`` line, so the fix (wire it or delete it) is one jump
+away.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from deepinteract_tpu.analysis.core import Finding, SourceFile, register
+
+RULE = "dead-cli-flag"
+
+# Files whose add_argument calls define the checked surface.
+REGISTRY_FILES = ("deepinteract_tpu/cli/args.py", "cli/args.py")
+
+
+def _registered_flags(tree: ast.AST) -> List[Tuple[str, str, int]]:
+    """(flag, dest, line) for every long-option add_argument call."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            continue
+        flag = None
+        for arg in node.args:
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("--")):
+                flag = arg.value
+                break
+        if flag is None:
+            continue
+        dest = flag.lstrip("-").replace("-", "_")
+        for kw in node.keywords:
+            if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                dest = str(kw.value.value)
+        out.append((flag, dest, node.lineno))
+    return out
+
+
+def _registration_nodes(tree: ast.AST) -> Set[int]:
+    """ids of every node inside an ``add_argument(...)`` call — reads in
+    a registration (``default=cfg.x_flag``) must not count as consuming
+    the dest, or exactly the flags most likely dead (wired only to a
+    config default) would self-mask."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            for sub in ast.walk(node):
+                out.add(id(sub))
+    return out
+
+
+def _attribute_reads(tree: ast.AST, skip: Set[int] = frozenset()
+                     ) -> Set[str]:
+    """Names that count as reading a dest: attribute Loads, getattr/
+    hasattr string constants, string subscripts (``vars(args)['x']``),
+    and ``.get('x')`` calls — the dict-shaped consumption paths a
+    ``vars(args)`` round trip produces. Nodes in ``skip`` (registration
+    subtrees) are ignored."""
+    reads: Set[str] = set()
+    for node in ast.walk(tree):
+        if id(node) in skip:
+            continue
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load):
+            reads.add(node.attr)
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.slice, ast.Constant)
+              and isinstance(node.slice.value, str)):
+            reads.add(node.slice.value)
+        elif isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in ("getattr", "hasattr")
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                reads.add(node.args[1].value)
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "get" and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)):
+                reads.add(node.args[0].value)
+    return reads
+
+
+@register(RULE, "cli/args.py flags whose args.<dest> is never read")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    registries = [f for f in files
+                  if f.path in REGISTRY_FILES and f.tree is not None]
+    if not registries:
+        return
+    reads: Set[str] = set()
+    flags: Dict[str, Tuple[str, str, int]] = {}
+    for f in files:
+        if f.tree is None:
+            continue
+        skip = (_registration_nodes(f.tree)
+                if f.path in REGISTRY_FILES else frozenset())
+        reads |= _attribute_reads(f.tree, skip)
+    for reg in registries:
+        for flag, dest, line in _registered_flags(reg.tree):
+            flags[flag] = (reg.path, dest, line)
+    for flag, (path, dest, line) in sorted(flags.items()):
+        if dest not in reads:
+            yield Finding(
+                rule=RULE, path=path, line=line,
+                message=(f"flag {flag} registers dest `{dest}` but "
+                         "nothing reads it — wire it up or delete the "
+                         "registration"))

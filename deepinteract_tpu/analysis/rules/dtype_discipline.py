@@ -1,0 +1,78 @@
+"""Rule ``dtype-discipline``: no hardcoded float dtypes in ``models/``
+outside ``models/policy.py``.
+
+The dtype policy (``deepinteract_tpu/models/policy.py``) is the single
+place model code may name a precision: statistics accumulate in
+``STATS_DTYPE``, outward-facing tensors are ``OUTPUT_DTYPE``, activations
+follow the configured compute dtype. A stray ``jnp.float32`` cast inside
+a model silently pins part of the graph to full precision (the pre-r6
+decoder had exactly such islands, which neutralized bf16 until they were
+hunted down one by one) — or worse, a stray ``jnp.bfloat16`` bypasses the
+policy's float32 guarantees for params/norms/logits.
+
+Only real attribute references to the dtype names on the ``jnp`` / ``np``
+/ ``jax.numpy`` / ``numpy`` modules count — strings mentioning 'float32'
+(config values like ``compute_dtype="float32"``) and comparisons against
+those strings do not. ``tools/check_dtype_discipline.py`` is the
+standalone shim over this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, Sequence, Tuple
+
+from deepinteract_tpu.analysis.core import Finding, SourceFile, register
+
+RULE = "dtype-discipline"
+
+# Files (by basename) inside the scanned scope where naming a dtype is
+# the point.
+ALLOWED_FILES = {"policy.py"}
+
+# Forbidden attribute names on a numpy-ish module object.
+DTYPE_ATTRS = {"float32", "bfloat16", "float16", "float64"}
+
+# Module aliases whose dtype attributes count as hardcoding.
+NUMPY_MODULES = {"jnp", "np", "numpy"}
+
+SCOPE_PREFIXES = ("deepinteract_tpu/models/", "models/")
+
+
+def _is_numpy_module(node: ast.expr) -> bool:
+    """True for ``jnp`` / ``np`` / ``numpy`` names and ``jax.numpy``."""
+    if isinstance(node, ast.Name):
+        return node.id in NUMPY_MODULES
+    if isinstance(node, ast.Attribute):  # jax.numpy
+        return (isinstance(node.value, ast.Name)
+                and node.value.id == "jax" and node.attr == "numpy")
+    return False
+
+
+def in_scope(path: str) -> bool:
+    if path.rsplit("/", 1)[-1] in ALLOWED_FILES:
+        return False
+    return path.startswith(SCOPE_PREFIXES)
+
+
+def violations_in_tree(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+    """(line, message) per hardcoded dtype reference — the single
+    implementation behind both the rule and the tools/ shim."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr in DTYPE_ATTRS
+                and _is_numpy_module(node.value)):
+            yield (node.lineno,
+                   f"hardcoded dtype '{ast.unparse(node)}' — import it "
+                   "from models/policy.py (STATS_DTYPE / OUTPUT_DTYPE / "
+                   "FLOAT32 / compute_dtype()) so precision has one "
+                   "authority")
+
+
+@register(RULE, "no hardcoded float dtypes in models/ outside policy.py")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    for f in files:
+        if f.tree is None or not in_scope(f.path):
+            continue
+        for line, message in violations_in_tree(f.tree):
+            yield Finding(rule=RULE, path=f.path, line=line, message=message)

@@ -1,0 +1,530 @@
+"""Rule ``jit-host-sync``: host synchronization inside traced functions.
+
+The MFU burn-down (ROADMAP item 2) lives and dies by device-loop purity:
+one ``.item()`` / ``float()`` / ``np.asarray`` on a traced value inside a
+jitted hot path either fails at trace time or — worse, when it survives
+via a ``jax.debug`` escape or a rarely-hit branch — forces a blocking
+device→host transfer per step. AlphaFold-class JAX stacks enforce exactly
+this discipline statically; this rule is that enforcement for models/,
+ops/, training/, parallel/ and the serving engine.
+
+**Which functions are "traced"** (module-local, name-based):
+
+* functions decorated with ``jax.jit`` / ``pjit`` / ``jax.checkpoint``
+  (bare or under ``functools.partial``);
+* functions passed to ``jax.jit(...)`` / ``pjit(...)`` /
+  ``jax.checkpoint(...)`` / ``nn.remat(...)`` anywhere in the module —
+  including ``jax.jit(self._forward)``-style method references — and
+  scan bodies handed to ``jax.lax.scan(f, ...)``;
+* every method of a ``flax.linen`` module class (bases mentioning
+  ``nn.Module`` / ``Module`` / a known module base) — flax ``__call__``
+  graphs only ever execute under a trace here;
+* functions transitively called from the above by bare name or
+  ``self.<method>`` within the same module.
+
+**What is flagged inside them**, using an intraprocedural taint pass
+(parameters are tracers — minus ``static_argnames``/``static_argnums`` —
+and taint propagates through assignments; ``.shape``/``.dtype``/
+``.ndim``/``.size`` reads are static under trace and drop taint):
+
+* ``x.item()`` / ``x.tolist()`` on a tainted value;
+* builtin ``float()`` / ``int()`` / ``bool()`` over a tainted value;
+* ``np.asarray`` / ``np.array`` / ``jax.device_get`` over a tainted
+  value (host materialization mid-trace);
+* ``if`` / ``while`` / ``assert`` / ternary conditions that read a
+  tainted value (Python control flow on a tracer) — ``x is None``
+  checks, ``isinstance``, and shape/dtype reads are exempt.
+
+False positives are expected to be rare but possible (a helper shared by
+traced and host-side callers); suppress with ``# di: allow[jit-host-sync]
+<reason>`` or accept into ``LINT_BASELINE.json``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from deepinteract_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    dotted_name as _dotted,
+    register,
+)
+
+RULE = "jit-host-sync"
+
+SCOPE_PREFIXES = (
+    "deepinteract_tpu/models/", "deepinteract_tpu/ops/",
+    "deepinteract_tpu/training/", "deepinteract_tpu/parallel/",
+    "deepinteract_tpu/serving/",
+    # fixture trees (tests point --root at a mini package)
+    "models/", "ops/", "training/", "parallel/", "serving/",
+)
+
+# Attribute reads that are STATIC under trace: taint does not flow out.
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
+
+# flax module base names (by last attribute segment).
+MODULE_BASES = {"Module"}
+
+# Call roots that make an argument function "traced".
+_JIT_CALLS = {("jax", "jit"), ("jax", "pjit"), ("jax", "checkpoint"),
+              ("nn", "remat"), ("nn", "jit"), ("jax", "remat")}
+# lax control-flow primitives: WHICH positional args are the function
+# operands (scan(f,...), while_loop(cond_fun, body_fun,...),
+# fori_loop(lo, hi, body,...), cond(pred, true_fn, false_fn,...)) —
+# predicates/bounds at the other positions must not mark same-named
+# functions as traced.
+_LAX_FN_ARGS = {
+    "scan": (0,), "map": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": (1, 2, 3, 4),
+}
+
+
+def _unwrap_partial(call: ast.expr) -> ast.expr:
+    """partial(jax.jit, ...) -> jax.jit; anything else unchanged."""
+    if isinstance(call, ast.Call):
+        d = _dotted(call.func)
+        if d and d[-1] == "partial" and call.args:
+            return call.args[0]
+    return call
+
+
+def _static_params(deco: ast.expr, fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names pinned static by a jit decorator's
+    static_argnames/static_argnums (they are Python values, not tracers)."""
+    out: Set[str] = set()
+    if not isinstance(deco, ast.Call):
+        return out
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in deco.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if (isinstance(n, ast.Constant)
+                        and isinstance(n.value, int)
+                        and 0 <= n.value < len(params)):
+                    out.add(params[n.value])
+    return out
+
+
+class _ModuleIndex:
+    """Per-file function inventory + traced-entry discovery."""
+
+    def __init__(self, tree: ast.AST):
+        # qualname -> (FunctionDef, owning class name or None)
+        self.functions: Dict[str, Tuple[ast.FunctionDef, Optional[str]]] = {}
+        self.methods_by_class: Dict[str, Set[str]] = {}
+        self.flax_classes: Set[str] = set()
+        self.traced: Dict[str, Set[str]] = {}  # qualname -> static params
+        self._collect(tree)
+        self._find_traced_refs(tree)
+
+    def _collect(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                bases = set()
+                for b in node.bases:
+                    d = _dotted(b)
+                    if d:
+                        bases.add(d[-1])
+                if bases & MODULE_BASES:
+                    self.flax_classes.add(node.name)
+                self.methods_by_class[node.name] = set()
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qual = f"{node.name}.{item.name}"
+                        self.functions[qual] = (item, node.name)
+                        self.methods_by_class[node.name].add(item.name)
+        # Module-level (and nested) functions not claimed by a class.
+        claimed = {fn for fn, _ in self.functions.values()}
+        for node in ast.walk(tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node not in claimed
+                    and node.name not in self.functions):
+                self.functions[node.name] = (node, None)
+
+    def _mark(self, qual: str, static: Set[str]) -> None:
+        if qual in self.functions:
+            self.traced.setdefault(qual, set()).update(static)
+
+    def _mark_by_name(self, name: str, static: Set[str],
+                      static_idx: Set[int] = frozenset()) -> None:
+        """A bare or attribute function reference: mark every matching
+        def (method name collisions are conservative — better two
+        analyses than a missed hot path). ``static_idx`` holds
+        call-site ``static_argnums`` integers, resolved against each
+        matched function's own parameter list."""
+        for qual in self.functions:
+            if qual == name or qual.endswith(f".{name}"):
+                fn, _cls = self.functions[qual]
+                params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+                resolved = set(static) | {
+                    params[i] for i in static_idx if 0 <= i < len(params)}
+                self._mark(qual, resolved)
+
+    def _find_traced_refs(self, tree: ast.AST) -> None:
+        # 1. decorators
+        for qual, (fn, _cls) in list(self.functions.items()):
+            for deco in fn.decorator_list:
+                target = _unwrap_partial(deco)
+                d = _dotted(target)
+                if d and (d in _JIT_CALLS or d[-1] in ("jit", "pjit")):
+                    self._mark(qual, _static_params(deco, fn))
+        # 2. call sites: jax.jit(f) / lax.scan(body, ...) / nn.remat(f)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            refs: List[ast.expr] = []
+            if d in _JIT_CALLS or (len(d) >= 2 and d[-1] == "jit"):
+                refs = list(node.args[:1])
+            elif (d[-1] in _LAX_FN_ARGS
+                  and d[:-1] in ((), ("lax",), ("jax", "lax"))
+                  and d != ("map",)):  # bare map() is the host builtin
+                refs = [node.args[i] for i in _LAX_FN_ARGS[d[-1]]
+                        if i < len(node.args)]
+            static: Set[str] = set()
+            static_idx: Set[int] = set()
+            for kw in node.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant):
+                            if isinstance(n.value, str):
+                                static.add(n.value)
+                            elif isinstance(n.value, int):
+                                static_idx.add(n.value)
+            for ref in refs:
+                rd = _dotted(ref)
+                if rd is None:
+                    continue
+                # self._forward -> _forward; module fn -> name as-is
+                self._mark_by_name(rd[-1], static, static_idx)
+        # 3. flax module methods
+        for qual, (fn, cls) in self.functions.items():
+            if cls in self.flax_classes:
+                self._mark(qual, set())
+
+    def close_over_calls(self) -> None:
+        """Transitive closure: a function called (by bare name or
+        ``self.x``) from a traced function is traced too."""
+        changed = True
+        while changed:
+            changed = False
+            for qual in list(self.traced):
+                fn, cls = self.functions[qual]
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = _dotted(node.func)
+                    if d is None:
+                        continue
+                    callee: Optional[str] = None
+                    if len(d) == 1 and d[0] in self.functions:
+                        callee = d[0]
+                    elif (len(d) == 2 and d[0] == "self" and cls
+                          and d[1] in self.methods_by_class.get(cls, ())):
+                        callee = f"{cls}.{d[1]}"
+                    if callee and callee not in self.traced:
+                        self.traced[callee] = set()
+                        changed = True
+
+
+# Parameter annotations that mark a STATIC Python value, not a tracer
+# (flax's ``train: bool`` convention and friends).
+_STATIC_ANNOTATIONS = {"bool", "str", "int", "Optional[bool]",
+                       "Optional[str]", "Optional[int]"}
+
+
+def _annotated_static(arg: ast.arg) -> bool:
+    if arg.annotation is None:
+        return False
+    try:
+        text = ast.unparse(arg.annotation).replace(" ", "")
+    except Exception:  # pragma: no cover - unparse is total on real ASTs
+        return False
+    return text in _STATIC_ANNOTATIONS
+
+
+class _TaintChecker:
+    """Intraprocedural taint from tracer-bearing params to host syncs."""
+
+    def __init__(self, fn: ast.FunctionDef, static_params: Set[str],
+                 qual: str):
+        self.fn = fn
+        self.qual = qual
+        args = fn.args
+        params = list(args.posonlyargs + args.args + args.kwonlyargs)
+        names = []
+        for a in params:
+            if not _annotated_static(a):
+                names.append(a.arg)
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        self.tainted: Set[str] = {
+            n for n in names
+            if n not in ("self", "cls") and n not in static_params}
+        self.findings: List[Tuple[int, str]] = []
+
+    # -- taint queries ----------------------------------------------------
+
+    def _static_name_ids(self, root: ast.expr) -> Set[int]:
+        """ids of Name nodes whose value is STATIC at trace time even if
+        the name is tainted: operands of ``is``/``is not`` comparisons,
+        comparisons against string constants (tracers are never strings),
+        arguments of isinstance/hasattr/callable/len, and anything that
+        only feeds a ``.shape``/``.dtype``/``.ndim``/``.size`` read."""
+        static: Set[int] = set()
+
+        def blank(node: ast.expr) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    static.add(id(sub))
+
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in sub.ops):
+                    blank(sub)
+                elif any(self._is_strish_constant(c)
+                         for c in [sub.left] + list(sub.comparators)):
+                    blank(sub)
+            elif (isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Name)
+                  and sub.func.id in ("isinstance", "hasattr", "callable",
+                                      "len", "getattr")):
+                blank(sub)
+            elif (isinstance(sub, ast.Attribute)
+                  and sub.attr in STATIC_ATTRS):
+                blank(sub.value)
+        return static
+
+    @staticmethod
+    def _is_strish_constant(node: ast.expr) -> bool:
+        """A string constant, or a tuple/list of constants containing one
+        (``x in ("auto", "pallas")`` — tracers are never strings)."""
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, str)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(isinstance(el, ast.Constant)
+                       and isinstance(el.value, str) for el in node.elts)
+        return False
+
+    def _expr_tainted(self, node: ast.expr) -> bool:
+        """Does evaluating ``node`` read a tainted value (ignoring reads
+        that are static under trace)?"""
+        static = self._static_name_ids(node)
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                    and sub.id in self.tainted and id(sub) not in static):
+                return True
+        return False
+
+    def _producer_call(self, node: ast.expr) -> bool:
+        """jnp./jax.lax./jax.nn. calls produce traced arrays even from
+        constant inputs."""
+        if not isinstance(node, ast.Call):
+            return False
+        d = _dotted(node.func)
+        return bool(d) and d[0] in ("jnp", "lax") or bool(
+            d and len(d) >= 2 and d[0] == "jax")
+
+    # -- walk -------------------------------------------------------------
+
+    def run(self) -> List[Tuple[int, str]]:
+        self._block(self.fn.body)
+        return self.findings
+
+    def _block(self, stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs (scan bodies etc.): params of a nested function
+            # handed to lax.scan are traced; analyzed via the module index
+            # when referenced — here just propagate current taint.
+            self._block(stmt.body)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._check_expr(stmt.value)
+            if self._expr_tainted(stmt.value) or self._producer_call(
+                    stmt.value):
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.tainted.add(n.id)
+            else:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.tainted.discard(t.id)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._check_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name) and (
+                    self._expr_tainted(stmt.value)
+                    or self._producer_call(stmt.value)):
+                self.tainted.add(stmt.target.id)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._check_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                if self._expr_tainted(stmt.value) or self._producer_call(
+                        stmt.value):
+                    self.tainted.add(stmt.target.id)
+                else:
+                    self.tainted.discard(stmt.target.id)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._check_condition(stmt.test,
+                                  "if" if isinstance(stmt, ast.If)
+                                  else "while")
+            self._check_expr(stmt.test)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._check_condition(stmt.test, "assert")
+            self._check_expr(stmt.test)
+            return
+        if isinstance(stmt, ast.For):
+            # Iterating a Python LIST of tracers is trace-legal and
+            # common (layer stacks); iterating a traced array is not, but
+            # the two are statically indistinguishable — so `for` is not
+            # flagged, only checked for nested sync calls.
+            self._check_expr(stmt.iter)
+            # Loop targets inherit the iterated expression's taint only:
+            # `for blk in self.blocks` yields static config, `for row in
+            # tainted_list` yields traced values.
+            if self._expr_tainted(stmt.iter) or self._producer_call(
+                    stmt.iter):
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        self.tainted.add(n.id)
+            else:
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        self.tainted.discard(n.id)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+            self._block(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body)
+            for h in stmt.handlers:
+                self._block(h.body)
+            self._block(stmt.orelse)
+            self._block(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._check_expr(stmt.value)
+            return
+        # Everything else (pass, break, raise, ...): check nested exprs.
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.expr):
+                self._check_expr(n)
+                break
+
+    # -- checks -----------------------------------------------------------
+
+    def _prune_static_tests(self, test: ast.expr) -> List[ast.expr]:
+        """Split a condition into operands, dropping host-legal ones:
+        ``x is (not) None`` and ``isinstance(...)``."""
+        if isinstance(test, ast.BoolOp):
+            out: List[ast.expr] = []
+            for v in test.values:
+                out.extend(self._prune_static_tests(v))
+            return out
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._prune_static_tests(test.operand)
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return []
+        if (isinstance(test, ast.Call) and isinstance(test.func, ast.Name)
+                and test.func.id in ("isinstance", "hasattr", "callable",
+                                     "getattr", "len")):
+            return []
+        return [test]
+
+    def _check_condition(self, test: ast.expr, kind: str) -> None:
+        for operand in self._prune_static_tests(test):
+            if self._expr_tainted(operand):
+                self.findings.append((
+                    test.lineno,
+                    f"Python `{kind}` on a traced value in "
+                    f"`{self.qual}` — control flow must be lax.cond/"
+                    "select/where inside a jitted function"))
+                return
+
+    def _check_expr(self, node: ast.expr) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.IfExp):
+                self._check_condition(sub.test, "ternary")
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            # x.item() / x.tolist()
+            if (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("item", "tolist")
+                    and self._expr_tainted(sub.func.value)):
+                self.findings.append((
+                    sub.lineno,
+                    f"`.{sub.func.attr}()` on a traced value in "
+                    f"`{self.qual}` — blocking device->host sync inside "
+                    "a jitted function"))
+                continue
+            d = _dotted(sub.func)
+            if d is None:
+                continue
+            # float()/int()/bool() on a traced value
+            if (d in (("float",), ("int",), ("bool",)) and sub.args
+                    and self._expr_tainted(sub.args[0])):
+                self.findings.append((
+                    sub.lineno,
+                    f"`{d[0]}()` over a traced value in `{self.qual}` — "
+                    "concretizes the tracer (host sync or trace error)"))
+                continue
+            # np.asarray / np.array / jax.device_get on a traced value
+            if ((d in (("np", "asarray"), ("np", "array"),
+                       ("numpy", "asarray"), ("numpy", "array"),
+                       ("jax", "device_get")))
+                    and sub.args and self._expr_tainted(sub.args[0])):
+                self.findings.append((
+                    sub.lineno,
+                    f"`{'.'.join(d)}` over a traced value in "
+                    f"`{self.qual}` — host materialization inside a "
+                    "jitted function"))
+
+
+def in_scope(path: str) -> bool:
+    return path.startswith(SCOPE_PREFIXES)
+
+
+@register(RULE, "host syncs / Python branching inside jit-traced functions")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    for f in files:
+        if f.tree is None or not in_scope(f.path):
+            continue
+        index = _ModuleIndex(f.tree)
+        index.close_over_calls()
+        for qual, static in sorted(index.traced.items()):
+            fn, _cls = index.functions[qual]
+            for line, message in _TaintChecker(fn, static, qual).run():
+                yield Finding(rule=RULE, path=f.path, line=line,
+                              message=message)

@@ -1,0 +1,238 @@
+"""Rule ``lock-discipline``: guarded attributes accessed without the lock.
+
+The serving/scheduler/screening layers are thread-shared by design
+(handler threads, the micro-batch worker, loader prefetch threads), and
+their invariant is lexical: a class that owns a ``threading.Lock`` /
+``RLock`` / ``Condition`` mutates its shared attributes only inside
+``with self._lock:`` blocks. A read or write that escapes the block is a
+data race the tests will never reliably catch — exactly the class of bug
+multi-worker serving (ROADMAP item 1) turns load-bearing.
+
+Two patterns per lock-owning class:
+
+1. **guarded-attr escape** — ``self.x`` is *mutated* under a ``with
+   self._lock:`` block somewhere (assignment, augmented assignment,
+   ``self.x[k] = v``, or a mutating method call like ``.append``/
+   ``.popitem``), but read or written outside any such block in another
+   method. ``__init__`` is exempt (construction happens-before sharing).
+2. **unguarded read-modify-write** — ``self.x += ...`` outside any lock
+   block in a class that owns a lock: ``+=`` on shared state is a load/
+   store pair that interleaves, whether or not the attribute is also
+   touched under the lock elsewhere.
+
+Helpers that are only ever CALLED with the lock held (the
+``_take_ready_group`` convention) are lexical false positives: suppress
+with ``# di: allow[lock-discipline] caller holds <lock>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from deepinteract_tpu.analysis.core import Finding, SourceFile, register
+
+RULE = "lock-discipline"
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# Method calls that mutate their receiver in place.
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "remove", "discard",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault",
+    "move_to_end", "sort", "reverse", "__setitem__",
+}
+
+# Methods where bare access is construction, not sharing.
+EXEMPT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """'x' for a ``self.x`` expression, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_ctor(value: ast.expr) -> bool:
+    """threading.Lock() / Lock() / threading.Condition(lock) ..."""
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in LOCK_FACTORIES
+    if isinstance(fn, ast.Name):
+        return fn.id in LOCK_FACTORIES
+    return False
+
+
+# Anchored to the attribute's final name token: `_exec_lock`, `_cv`,
+# `lock`, `io_mutex`, `ready_cond` — but NOT `self._blocker` or
+# `self.block` (a non-lock context manager must not turn the class into
+# a lock-owner and spray false findings).
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|cv|cond|condition)$",
+                           re.IGNORECASE)
+
+
+class _ClassAnalysis:
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.lock_attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        self.lock_attrs.add(attr)
+            # ``with self._lock:`` on a lock-named attribute counts even
+            # without a visible constructor — the Lock may be assigned in
+            # a base class (obs/metrics.py's _Family hierarchy).
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr and _LOCK_NAME_RE.search(attr):
+                        self.lock_attrs.add(attr)
+        # (attr, line, kind) accesses, split by under-lock / outside.
+        self.guarded_mutated: Set[str] = set()
+        self.outside: List[Tuple[str, int, str, str]] = []  # attr, line, kind, method
+        self.methods: List[ast.FunctionDef] = [
+            item for item in cls.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _with_holds_lock(self, stmt: ast.With) -> bool:
+        return any(_self_attr(item.context_expr) in self.lock_attrs
+                   for item in stmt.items)
+
+    def scan(self) -> None:
+        if not self.lock_attrs:
+            return
+        for method in self.methods:
+            self._scan_block(method.body, under_lock=False,
+                             method=method.name)
+
+    def _scan_block(self, stmts: Sequence[ast.stmt], under_lock: bool,
+                    method: str) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                holds = under_lock or self._with_holds_lock(stmt)
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr, under_lock, method)
+                self._scan_block(stmt.body, holds, method)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested def: runs later, lock NOT held at run time.
+                self._scan_block(stmt.body, False, method)
+                continue
+            # Statement-level mutations first, then nested expressions.
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self._record_target(t, under_lock, method)
+                self._scan_expr(stmt.value, under_lock, method)
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                self._record_target(stmt.target, under_lock, method,
+                                    aug=True)
+                self._scan_expr(stmt.value, under_lock, method)
+                continue
+            if isinstance(stmt, ast.AnnAssign):
+                self._record_target(stmt.target, under_lock, method)
+                if stmt.value is not None:
+                    self._scan_expr(stmt.value, under_lock, method)
+                continue
+            if isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    self._record_target(t, under_lock, method)
+                continue
+            # Control flow: recurse into child blocks with same state.
+            for field in ("body", "orelse", "finalbody"):
+                child = getattr(stmt, field, None)
+                if child:
+                    self._scan_block(child, under_lock, method)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._scan_block(h.body, under_lock, method)
+            for field in ("test", "iter", "value", "exc"):
+                child = getattr(stmt, field, None)
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child, under_lock, method)
+
+    def _record_target(self, target: ast.expr, under_lock: bool,
+                       method: str, aug: bool = False) -> None:
+        attr = _self_attr(target)
+        if attr is None and isinstance(target, (ast.Subscript,
+                                                ast.Attribute)):
+            # self.x[k] = v  /  self.x.y = v  mutate self.x
+            attr = _self_attr(getattr(target, "value", None))
+        if attr is None or attr in self.lock_attrs:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for el in target.elts:
+                    self._record_target(el, under_lock, method, aug=aug)
+            return
+        kind = "augmented write" if aug else "write"
+        if under_lock:
+            self.guarded_mutated.add(attr)
+        else:
+            self.outside.append((attr, target.lineno, kind, method))
+
+    def _scan_expr(self, expr: ast.expr, under_lock: bool,
+                   method: str) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute):
+                attr = _self_attr(sub.func.value)
+                if attr and attr not in self.lock_attrs and (
+                        sub.func.attr in MUTATORS):
+                    if under_lock:
+                        self.guarded_mutated.add(attr)
+                    else:
+                        self.outside.append(
+                            (attr, sub.lineno, f".{sub.func.attr}()",
+                             method))
+                    continue
+            attr = _self_attr(sub)
+            if attr and attr not in self.lock_attrs and isinstance(
+                    sub.ctx, ast.Load):
+                if not under_lock:
+                    self.outside.append((attr, sub.lineno, "read", method))
+
+    def findings(self, path: str) -> Iterable[Finding]:
+        if not self.lock_attrs:
+            return
+        locks = "/".join(sorted(self.lock_attrs))
+        reported: Set[Tuple[str, int]] = set()
+        for attr, line, kind, method in self.outside:
+            if method in EXEMPT_METHODS:
+                continue
+            key = (attr, line)
+            if key in reported:
+                continue
+            if attr in self.guarded_mutated:
+                reported.add(key)
+                yield Finding(
+                    rule=RULE, path=path, line=line,
+                    message=(f"{self.cls.name}.{attr} {kind} in "
+                             f"`{method}` without holding self.{locks} — "
+                             "the attribute is mutated under the lock "
+                             "elsewhere"))
+            elif kind == "augmented write":
+                reported.add(key)
+                yield Finding(
+                    rule=RULE, path=path, line=line,
+                    message=(f"{self.cls.name}.{attr} `+=` in `{method}` "
+                             f"without holding self.{locks} — unguarded "
+                             "read-modify-write on shared state in a "
+                             "lock-owning class"))
+
+
+@register(RULE, "lock-guarded attributes accessed without the lock")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    for f in files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef):
+                analysis = _ClassAnalysis(node)
+                analysis.scan()
+                yield from analysis.findings(f.path)

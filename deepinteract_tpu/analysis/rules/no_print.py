@@ -1,0 +1,55 @@
+"""Rule ``no-print``: no bare ``print()`` in the package outside ``cli/``.
+
+Library, training, serving, and pipeline code must report through
+``logging`` or the telemetry registry (``deepinteract_tpu/obs``) so output
+is structured, filterable, and visible to exposition — a stray print
+bypasses all three and disappears in multi-host runs. The CLI entry
+points and the repo-level scripts (``bench.py``, ``tools/``) are the
+sanctioned stdout surfaces.
+
+Only real ``print(...)`` *calls* to the builtin name count — ``log_fn=
+print`` defaults, methods named print, and strings mentioning print() do
+not. ``tools/check_no_print.py`` is the standalone shim over this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, Sequence, Tuple
+
+from deepinteract_tpu.analysis.core import Finding, SourceFile, register
+
+RULE = "no-print"
+
+# Path prefixes (relative to the scan root) where bare print() is the
+# intended UX.
+ALLOWED_PREFIXES = ("deepinteract_tpu/cli/", "cli/", "tools/")
+ALLOWED_FILES = ("bench.py", "__graft_entry__.py")
+
+MESSAGE = ("bare print() — use logging or the obs registry "
+           "(cli/ and bench.py are exempt)")
+
+
+def in_scope(path: str) -> bool:
+    if path in ALLOWED_FILES:
+        return False
+    return not path.startswith(ALLOWED_PREFIXES)
+
+
+def violations_in_tree(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+    """(line, message) for each bare builtin print call — the single
+    implementation behind both the rule and the tools/ shim."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            yield node.lineno, MESSAGE
+
+
+@register(RULE, "no bare print() outside cli/ (use logging / obs)")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    for f in files:
+        if f.tree is None or not in_scope(f.path):
+            continue
+        for line, message in violations_in_tree(f.tree):
+            yield Finding(rule=RULE, path=f.path, line=line, message=message)

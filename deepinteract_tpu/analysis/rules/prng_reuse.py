@@ -1,0 +1,228 @@
+"""Rule ``prng-key-reuse``: a jax.random key consumed twice without a split.
+
+JAX PRNG discipline: a key is single-use. Passing the same key to two
+samplers yields IDENTICAL randomness (correlated dropout masks, duplicate
+init noise) — silently, since nothing crashes. The convention is
+``key, sub = jax.random.split(key)`` before every consumption, or
+``fold_in`` with a distinct step.
+
+Detection (per function, linear over the statement order):
+
+* a name becomes a **key** when assigned from ``jax.random.PRNGKey`` /
+  ``key`` / ``split`` / ``fold_in`` (tuple unpacking from ``split``
+  marks every target);
+* any appearance of a key name inside a later call's arguments counts as
+  one **consumption** — including ``split(key)`` itself (after splitting,
+  the parent key must not be used again) and passing the key to a user
+  function (which presumably consumes it);
+* the SECOND consumption without an intervening reassignment is flagged.
+
+Reassignment (``key, sub = split(key)``) resets the count — the standard
+threading pattern stays silent. Uses on different branches of one ``if``
+are counted together (conservative: a reuse across exclusive branches is
+a false positive — suppress with ``# di: allow[prng-key-reuse]``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from deepinteract_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    dotted_name as _dotted,
+    register,
+)
+
+RULE = "prng-key-reuse"
+
+SCOPE_PREFIX = ("deepinteract_tpu/",)
+# Producers: assignment RHS rooted here makes the target a key.
+_PRODUCERS = {"PRNGKey", "key", "split", "fold_in", "clone"}
+
+# Parameters that ARE keys by naming convention. `*_rng` / `*prng_key` /
+# `rng_key` are unambiguous and seed unconditionally — a received key
+# consumed twice is the dominant real-world reuse, including when both
+# consumptions are helper calls. Bare `key`/`rng` and generic `*_key`
+# collide with CACHE keys (serving/cache.py `key`, engine `bucket_key`)
+# and numpy Generators (data/synthetic.py `rng`), so those only seed
+# when the function itself calls jax.random.*.
+_STRONG_KEY_PARAM_RE = re.compile(r"_rng$|prng_key$|^rng_key$")
+_WEAK_KEY_PARAM_RE = re.compile(r"^(key|rng)$|_key$")
+
+
+def _random_aliases(tree: ast.AST) -> Set[str]:
+    """Names that refer to the jax.random module in this file:
+    always {'jax.random'}, plus ``import jax.random as jr`` /
+    ``from jax import random`` aliases."""
+    aliases = {("jax", "random")}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    aliases.add((a.asname,))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        aliases.add(((a.asname or "random"),))
+    return aliases
+
+
+class _FnChecker:
+    def __init__(self, fn: ast.FunctionDef, aliases: Set[Tuple[str, ...]],
+                 qual: str):
+        self.fn = fn
+        self.aliases = aliases
+        self.qual = qual
+        self.uses: Dict[str, int] = {}       # key name -> consumptions
+        self.flagged: Set[str] = set()       # one finding per key per fn
+        self.findings: List[Tuple[int, str]] = []
+        args = fn.args
+        calls_random = self._calls_jax_random(fn)
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if _STRONG_KEY_PARAM_RE.search(a.arg) or (
+                    calls_random and _WEAK_KEY_PARAM_RE.search(a.arg)):
+                self.uses[a.arg] = 0
+
+    def _calls_jax_random(self, fn: ast.FunctionDef) -> bool:
+        return any(isinstance(n, ast.Call)
+                   and self._random_call(n) is not None
+                   for n in ast.walk(fn))
+
+    def _random_call(self, node: ast.expr) -> Optional[str]:
+        """'split' for jax.random.split(...) (under any alias)."""
+        if not isinstance(node, ast.Call):
+            return None
+        d = _dotted(node.func)
+        if d is None or len(d) < 2:
+            return None
+        return d[-1] if d[:-1] in self.aliases else None
+
+    def run(self) -> List[Tuple[int, str]]:
+        for stmt in self._ordered_stmts(self.fn):
+            self._stmt(stmt)
+        return self.findings
+
+    @staticmethod
+    def _ordered_stmts(fn: ast.FunctionDef) -> List[ast.stmt]:
+        """All statements in the function in source order (nested blocks
+        flattened; nested function bodies excluded — they execute on
+        their own schedule)."""
+        out: List[ast.stmt] = []
+
+        def visit(stmts):
+            for s in stmts:
+                out.append(s)
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    child = getattr(s, field, None)
+                    if child:
+                        visit(child)
+                for h in getattr(s, "handlers", []) or []:
+                    visit(h.body)
+
+        visit(fn.body)
+        return out
+
+    @staticmethod
+    def _own_exprs(stmt: ast.stmt) -> List[ast.expr]:
+        """The expressions evaluated BY this statement itself — compound
+        statements contribute only their header (test/iter/items); their
+        bodies are separate entries in the flattened order."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return [item.context_expr for item in stmt.items]
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Try)):
+            return []
+        return [n for n in ast.iter_child_nodes(stmt)
+                if isinstance(n, ast.expr)]
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        # Consumption first (RHS evaluates before targets bind). Each
+        # Name node is counted at most once even when it sits inside
+        # nested calls (f(g(key)) is ONE consumption of key).
+        counted: Set[int] = set()
+        for expr in self._own_exprs(stmt):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    self._count_call(node, counted)
+        if isinstance(stmt, ast.Assign):
+            produced = self._produces_key(stmt.value)
+            for t in stmt.targets:
+                self._bind(t, produced)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, self._produces_key(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                self.uses.pop(stmt.target.id, None)
+
+    def _produces_key(self, value: ast.expr) -> bool:
+        kind = self._random_call(value)
+        return kind in _PRODUCERS if kind else False
+
+    def _bind(self, target: ast.expr, is_key: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, is_key)
+            return
+        if isinstance(target, ast.Name):
+            if is_key:
+                self.uses[target.id] = 0
+                self.flagged.discard(target.id)
+            else:
+                self.uses.pop(target.id, None)
+
+    def _count_call(self, call: ast.Call, counted: Set[int]) -> None:
+        consumed: List[Tuple[str, int]] = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            # `keys = split(key, n)` then `keys[0]`, `keys[1]` is the
+            # canonical batch-split idiom: a SUBSCRIPTED key name selects
+            # a distinct subkey per index, so it never counts as reuse of
+            # the array variable itself.
+            subscripted = {
+                id(sub.value) for sub in ast.walk(arg)
+                if isinstance(sub, ast.Subscript)
+                and isinstance(sub.value, ast.Name)}
+            for sub in ast.walk(arg):
+                if (isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in self.uses
+                        and id(sub) not in counted
+                        and id(sub) not in subscripted):
+                    counted.add(id(sub))
+                    consumed.append((sub.id, sub.lineno))
+        for name, lineno in consumed:
+            self.uses[name] += 1
+            if self.uses[name] >= 2 and name not in self.flagged:
+                self.flagged.add(name)
+                self.findings.append((
+                    lineno,
+                    f"PRNG key `{name}` consumed again in `{self.qual}` "
+                    "without an intervening jax.random.split — identical "
+                    "randomness at both sites"))
+
+
+def in_scope(path: str) -> bool:
+    return path.startswith(SCOPE_PREFIX) or "/" not in path
+
+
+@register(RULE, "jax.random key consumed twice without split/fold_in")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    for f in files:
+        if f.tree is None or not in_scope(f.path):
+            continue
+        aliases = _random_aliases(f.tree)
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = node.name
+                for line, message in _FnChecker(node, aliases, qual).run():
+                    yield Finding(rule=RULE, path=f.path, line=line,
+                                  message=message)

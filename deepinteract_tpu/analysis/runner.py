@@ -1,0 +1,102 @@
+"""File discovery + rule execution: parse once, run every rule, report.
+
+The scanned set is the PACKAGE plus the repo-level Python surfaces
+(``bench.py``, ``tools/``, ``__graft_entry__.py``) — tests are excluded
+by default (deliberate violations live there as fixtures), and each rule
+further scopes itself (e.g. dtype-discipline only reports on
+``models/``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Dict, List, Optional, Sequence
+
+from deepinteract_tpu.analysis.core import (
+    Finding,
+    Rule,
+    SourceFile,
+    all_rules,
+    assign_fingerprints,
+    get_rule,
+)
+
+# Repo-root entries scanned in addition to the package. docker/ is build
+# scaffolding, tests/ holds deliberate-violation fixtures.
+EXTRA_SCAN = ("bench.py", "__graft_entry__.py", "tools")
+SKIP_DIRS = {"__pycache__", ".git", "tests", "docker", "checkpoints"}
+
+
+def discover(root: pathlib.Path) -> List[pathlib.Path]:
+    """Python files under ``root``. When ``root`` is the repo (it contains
+    ``deepinteract_tpu/``), scan the package + EXTRA_SCAN; otherwise scan
+    the tree as-is (fixture trees in tests point --root anywhere)."""
+    root = root.resolve()
+    if (root / "deepinteract_tpu").is_dir():
+        candidates: List[pathlib.Path] = []
+        for sub in ("deepinteract_tpu",) + EXTRA_SCAN:
+            p = root / sub
+            if p.is_file():
+                candidates.append(p)
+            elif p.is_dir():
+                candidates.extend(sorted(p.rglob("*.py")))
+        paths = candidates
+    else:
+        paths = sorted(root.rglob("*.py")) if root.is_dir() else [root]
+    return [
+        p for p in paths
+        if not (set(p.relative_to(root).parts[:-1]) & SKIP_DIRS)
+    ]
+
+
+def load_files(root: pathlib.Path,
+               paths: Optional[Sequence[pathlib.Path]] = None
+               ) -> List[SourceFile]:
+    root = root.resolve()
+    return [SourceFile(root, p) for p in (paths or discover(root))]
+
+
+@dataclasses.dataclass
+class RunResult:
+    files: List[SourceFile]
+    findings: List[Finding]       # active (unsuppressed)
+    suppressed: List[Finding]
+    parse_failures: List[Finding]
+
+    @property
+    def files_by_path(self) -> Dict[str, SourceFile]:
+        return {f.path: f for f in self.files}
+
+    def fingerprinted(self):
+        return assign_fingerprints(self.findings, self.files_by_path)
+
+
+def run_rules(root: pathlib.Path,
+              rule_names: Optional[Sequence[str]] = None,
+              files: Optional[List[SourceFile]] = None) -> RunResult:
+    """Run the named rules (default: all registered) over ``root``."""
+    files = files if files is not None else load_files(root)
+    rules: List[Rule] = ([get_rule(n) for n in rule_names]
+                         if rule_names else all_rules())
+    parse_failures = [
+        Finding(rule="parse", path=f.path,
+                line=f.parse_error.lineno or 0,
+                message=f"unparseable: {f.parse_error.msg}")
+        for f in files if f.parse_error is not None
+    ]
+    by_path = {f.path: f for f in files}
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule in rules:
+        for finding in rule.check(files):
+            sf = by_path.get(finding.path)
+            if sf is not None and sf.is_suppressed(rule.name, finding.line):
+                suppressed.append(
+                    dataclasses.replace(finding, suppressed=True))
+            else:
+                active.append(finding)
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return RunResult(files=files, findings=active, suppressed=suppressed,
+                     parse_failures=parse_failures)

@@ -1,0 +1,141 @@
+"""Repo-wide static analysis in one process: ``python -m deepinteract_tpu.cli.lint``.
+
+Runs every registered rule (``deepinteract_tpu/analysis``) over the repo,
+prints findings, and ends with a machine-readable ``lint/v1`` contract
+line (validated by ``tools/check_cli_contract.py lint`` — the final-line
+discipline every driver-facing CLI here follows).
+
+Exit codes: 0 = clean against the committed baseline; 1 = new findings
+(or parse failures); 2 = bad invocation.
+
+Workflow::
+
+    python -m deepinteract_tpu.cli.lint                    # CI / tier-1
+    python -m deepinteract_tpu.cli.lint --rules lock-discipline
+    python -m deepinteract_tpu.cli.lint --update_baseline  # accept debt
+    python -m deepinteract_tpu.cli.lint --show_baselined   # audit debt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def _repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def main(argv=None) -> int:
+    from deepinteract_tpu.analysis import baseline as baseline_mod
+    from deepinteract_tpu.analysis.core import all_rules
+    from deepinteract_tpu.analysis.runner import run_rules
+
+    rule_names = sorted(r.name for r in all_rules())
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--root", type=pathlib.Path, default=_repo_root(),
+                        help="tree to scan (default: this repo)")
+    parser.add_argument("--rules", type=str, default=None,
+                        help="comma list of rules to run "
+                             f"(default all: {','.join(rule_names)})")
+    parser.add_argument("--baseline", type=pathlib.Path, default=None,
+                        help="baseline JSON path (default: "
+                             "<root>/LINT_BASELINE.json)")
+    parser.add_argument("--no_baseline", action="store_true",
+                        help="ignore the baseline: every finding fails "
+                             "(rule-development mode)")
+    parser.add_argument("--update_baseline", action="store_true",
+                        help="rewrite the baseline from this run's "
+                             "findings and exit 0")
+    parser.add_argument("--show_baselined", action="store_true",
+                        help="also print findings the baseline accepts")
+    parser.add_argument("--show_suppressed", action="store_true",
+                        help="also print '# di: allow'-suppressed findings")
+    args = parser.parse_args(argv)
+
+    root = args.root.resolve()
+    if not root.is_dir():
+        # A FILE root would silently defeat every path-scoped rule (the
+        # file's repo-relative path degenerates to '.') and report a
+        # false clean — refuse instead.
+        print(f"error: --root must be an existing directory, got {root}",
+              file=sys.stderr)
+        return 2
+    selected = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                if args.rules else None)
+    try:
+        result = run_rules(root, rule_names=selected)
+    except KeyError as exc:
+        print(f"error: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or (
+        root / baseline_mod.DEFAULT_BASELINE_NAME)
+    fingerprinted = result.fingerprinted()
+    # A --rules subset run only re-evaluated SOME rules: entries owned by
+    # the unselected rules are neither stale nor replaceable — carry them
+    # through updates and exclude them from classification.
+    ran = set(selected) if selected else None
+    if args.update_baseline:
+        foreign = []
+        if ran is not None:
+            foreign = [e for e in baseline_mod.load(baseline_path).values()
+                       if e["rule"] not in ran]
+        baseline_mod.save(baseline_path, fingerprinted,
+                          keep_entries=foreign)
+        print(f"baseline updated: {baseline_path} "
+              f"({len(fingerprinted)} finding(s) accepted"
+              + (f", {len(foreign)} kept from unselected rules"
+                 if foreign else "") + ")")
+        new, baselined, stale = [], fingerprinted, []
+    elif args.no_baseline:
+        new, baselined, stale = fingerprinted, [], []
+    else:
+        known = baseline_mod.load(baseline_path)
+        if ran is not None:
+            known = {fp: e for fp, e in known.items() if e["rule"] in ran}
+        new, baselined, stale = baseline_mod.classify(fingerprinted, known)
+
+    for f in result.parse_failures:
+        print(f.format())
+    for f, _fp in new:
+        print(f.format())
+    if args.show_baselined:
+        for f, fp in baselined:
+            print(f"{f.format()} (baselined {fp})")
+    if args.show_suppressed:
+        for f in result.suppressed:
+            print(f.format())
+    for entry in stale:
+        print(f"stale baseline entry {entry['fingerprint']} "
+              f"({entry['rule']} at {entry['path']}) no longer matches — "
+              "run --update_baseline to drop it")
+
+    failed = bool(new) or bool(result.parse_failures)
+    run_rule_names = selected or rule_names
+    contract = {
+        "schema": "lint/v1",
+        "metric": "lint_new_findings",
+        "value": len(new),
+        "unit": "findings",
+        "ok": not failed,
+        "rules": run_rule_names,
+        "files_scanned": len(result.files),
+        "findings_total": len(result.findings),
+        "findings_new": len(new),
+        "findings_baselined": len(baselined),
+        "suppressed": len(result.suppressed),
+        "stale_baseline_entries": len(stale),
+        "parse_failures": len(result.parse_failures),
+        "baseline": str(baseline_path),
+    }
+    print(json.dumps(contract))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

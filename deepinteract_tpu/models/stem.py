@@ -150,6 +150,7 @@ class PairStem1x1(nn.Module):
         r1 = x.feats1.astype(self.dtype) @ k[0, 0, :c1]    # [B, L1, F]
         r2 = x.feats2.astype(self.dtype) @ k[0, 0, c1:] + b  # [B, L2, F]
         out = r1[:, :, None, :] + r2[:, None, :, :]
+        # di: allow[jit-host-sync] shard_pair is pytree aux_data — a static bool at trace time
         if x.shard_pair:
             out = shard_pair_rows(out)
         return out
@@ -230,6 +231,7 @@ def factorized_stem_conv(factors: PairFactors, kernel, stride: int,
     a2 = _conv1d(g2, k2, stride, (lo_w, hi_w)).reshape(-1, out_w, kh, f)
     m1s = _shifted_mask(m1f, kh, stride, (lo_h, hi_h), out_h)
     y = y + jnp.einsum("bjtf,bit->bijf", a2, m1s)
+    # di: allow[jit-host-sync] shard_pair is pytree aux_data — a static bool at trace time
     if factors.shard_pair:
         y = shard_pair_rows(y)
     return y

@@ -94,6 +94,7 @@ class Counter(_Family):
 
     def _init_default_series(self) -> None:
         if not self.labelnames:
+            # di: allow[lock-discipline] called under _lock (clear) or before sharing (__init__)
             self._series[()] = 0.0
 
     def inc(self, amount: float = 1.0, **labels) -> None:
@@ -127,6 +128,7 @@ class Gauge(_Family):
 
     def _init_default_series(self) -> None:
         if not self.labelnames:
+            # di: allow[lock-discipline] called under _lock (clear) or before sharing (__init__)
             self._series[()] = 0.0
 
     def set(self, value: float, **labels) -> None:

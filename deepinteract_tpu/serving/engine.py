@@ -320,8 +320,10 @@ class InferenceEngine:
     # -- compile cache -----------------------------------------------------
 
     def _forward(self, params, batch_stats, graph1, graph2):
-        # Python side effect: executes once per TRACE, never per call.
-        self.trace_count += 1
+        # Python side effect: executes once per TRACE, never per call —
+        # and every trace runs inside _compiled's lower(), under
+        # _exec_lock.
+        self.trace_count += 1  # di: allow[lock-discipline] traces run under _exec_lock via _compiled
         import jax
 
         logits = self.model.apply(
@@ -341,7 +343,7 @@ class InferenceEngine:
 
     def _encode(self, params, batch_stats, graph):
         # Python side effect: executes once per TRACE, never per call.
-        self.trace_count += 1
+        self.trace_count += 1  # di: allow[lock-discipline] traces run under _exec_lock via _compiled
         import jax.numpy as jnp
 
         feats, _ = self.model.apply(
@@ -353,7 +355,7 @@ class InferenceEngine:
         return jnp.asarray(feats, dtype=jnp.float32)
 
     def _decode(self, params, batch_stats, feats1, feats2, mask1, mask2):
-        self.trace_count += 1
+        self.trace_count += 1  # di: allow[lock-discipline] traces run under _exec_lock via _compiled
         import jax
 
         logits = self.model.apply(
@@ -551,9 +553,13 @@ class InferenceEngine:
                 rt.set_phase("batch_assembly", t_assembled - t_dequeue)
                 rt.set_phase("compile", t_compiled - t_assembled)
                 rt.set_phase("device", t_fetched - t_compiled)
-        self._executed_batches += 1
-        self._executed_requests += len(items)
-        self._padded_slots += pad_slots
+        # Under _exec_lock: mutated on the scheduler worker thread, read
+        # by HTTP handler threads via stats() — a bare += is a
+        # read-modify-write race (lint: lock-discipline).
+        with self._exec_lock:
+            self._executed_batches += 1
+            self._executed_requests += len(items)
+            self._padded_slots += pad_slots
         _EXECUTED_BATCHES.inc()
         _EXECUTED_REQUESTS.inc(len(items))
         _PADDED_SLOTS.inc(pad_slots)
@@ -597,6 +603,9 @@ class InferenceEngine:
     def stats(self) -> Dict[str, Any]:
         with self._exec_lock:
             compiled = dict(self._compile_seconds)
+            executed_batches = self._executed_batches
+            executed_requests = self._executed_requests
+            padded_slots = self._padded_slots
         return {
             "uptime_seconds": time.time() - self._started,
             "restored_from": self.restored_from,
@@ -615,9 +624,9 @@ class InferenceEngine:
             "trace_count": self.trace_count,
             "compiled_buckets": compiled,
             "num_compiled_executables": len(compiled),
-            "executed_batches": self._executed_batches,
-            "executed_requests": self._executed_requests,
-            "padded_slots": self._padded_slots,
+            "executed_batches": executed_batches,
+            "executed_requests": executed_requests,
+            "padded_slots": padded_slots,
             "scheduler": self.scheduler.stats(),
             "result_cache": self.cache.stats(),
         }

@@ -125,6 +125,7 @@ class MicroBatchScheduler:
             if not q:
                 continue
             deadline = q[0][2] + self.max_delay_s
+            # di: allow[lock-discipline] caller holds _cv (see _loop/docstring)
             if len(q) >= self.max_batch or now >= deadline or self._closed:
                 # Oldest-deadline-first across READY buckets.
                 if ready_key is None or deadline < ready_deadline:

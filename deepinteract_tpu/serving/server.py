@@ -447,7 +447,7 @@ class ServingServer:
         # and /stats//metrics must not block behind in-flight device
         # work. A bare attribute read is atomic, and EmbeddingCache.
         # stats() takes the cache's own (short-held) lock.
-        cache = self._screen_cache
+        cache = self._screen_cache  # di: allow[lock-discipline] deliberate lock-free read, see comment above
         cache_stats = cache.stats() if cache is not None else {}
         return {
             "requests": _REQUESTS.value(endpoint="/screen", status="200"),

@@ -61,6 +61,7 @@ def lr_find(
         params=variables["params"],
         tx=tx,
         batch_stats=variables.get("batch_stats", {}),
+        # di: allow[prng-key-reuse] init ran train=False (dropout stream unsampled); the probe state mirrors create_train_state
         dropout_rng=dropout_rng,
     )
 

@@ -62,6 +62,7 @@ def create_train_state(
         params=variables["params"],
         tx=make_optimizer(optim_cfg, frozen_prefixes=frozen_prefixes),
         batch_stats=variables.get("batch_stats", {}),
+        # di: allow[prng-key-reuse] init ran train=False (dropout stream unsampled); splitting here would shift every historical dropout sequence
         dropout_rng=dropout_rng,
         bad_steps=jnp.zeros((), jnp.int32),
     )

@@ -72,7 +72,7 @@ def test_registered_kinds_cover_every_contract_cli():
     whose final line is a machine contract has a registered kind, so a
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
-            "perf_regression"} <= set(CONTRACTS)
+            "perf_regression", "lint"} <= set(CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
 
@@ -144,6 +144,20 @@ def test_tune_dry_run_capture_passes_tune_kind(tmp_path, capsys):
     rec = check_cli_contract_text(capsys.readouterr().out, "tune")
     assert rec["dry_run"] is True
     assert "b1_p64" in rec["buckets"] or rec["buckets"]
+
+
+def test_lint_kind_matches_real_cli_emission(tmp_path, capsys):
+    """The lint/v1 contract is validated against the REAL cli.lint run
+    over a tiny clean tree (pure AST work — no device, no compile)."""
+    from deepinteract_tpu.cli.lint import main
+
+    (tmp_path / "clean.py").write_text("import logging\n")
+    rc = main(["--root", str(tmp_path)])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "lint")
+    assert rec["schema"] == "lint/v1"
+    assert rec["ok"] is True and rec["findings_new"] == 0
+    assert "lock-discipline" in rec["rules"]
 
 
 def test_cli_main_entry(tmp_path, capsys):

@@ -1,0 +1,597 @@
+"""Tier-1 wiring of the unified static-analysis subsystem
+(deepinteract_tpu/analysis + cli/lint.py).
+
+Three layers of coverage:
+
+* **repo-wide** — the full lint run passes against the committed
+  ``LINT_BASELINE.json`` and ends in a valid ``lint/v1`` contract line
+  (the run every CI/driver invocation performs);
+* **per-rule fixtures** — each rule both FIRES on a deliberately-bad
+  snippet and respects a ``# di: allow[rule]`` suppression (an
+  always-green linter is worse than none);
+* **shim parity** — ``tools/check_no_print.py`` and
+  ``tools/check_dtype_discipline.py`` report identical findings to their
+  framework rules (single implementation, two entry points).
+"""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from deepinteract_tpu.analysis.runner import load_files, run_rules  # noqa: E402
+from tools.check_cli_contract import check_cli_contract_text  # noqa: E402
+
+
+def write_tree(root: pathlib.Path, files: dict) -> pathlib.Path:
+    for rel, content in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    return root
+
+
+def findings_of(root, rule, files=None):
+    result = run_rules(pathlib.Path(root), rule_names=[rule], files=files)
+    return result
+
+
+# -- repo-wide ------------------------------------------------------------
+
+
+def test_repo_wide_lint_passes_against_baseline(capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    rc = main([])
+    out = capsys.readouterr().out
+    rec = check_cli_contract_text(out, "lint")
+    assert rc == 0, f"lint found new findings:\n{out}"
+    assert rec["ok"] is True
+    assert rec["findings_new"] == 0
+    assert rec["parse_failures"] == 0
+    # All six rules ran in the one process.
+    assert set(rec["rules"]) == {
+        "no-print", "dtype-discipline", "jit-host-sync", "lock-discipline",
+        "prng-key-reuse", "dead-cli-flag"}
+    assert rec["files_scanned"] > 100
+
+
+def test_repo_wide_suppressions_are_intentional(capsys):
+    """Every suppressed finding in the repo carries a pragma some human
+    wrote next to real code; the count is pinned so a silently growing
+    suppression pile shows up in review."""
+    from deepinteract_tpu.cli.lint import main
+
+    main([])
+    rec = json.loads(
+        [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
+    assert rec["suppressed"] <= 15, (
+        "suppression count grew — justify or fix the new ones")
+
+
+def test_fixture_violation_fails_the_run(tmp_path, capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    write_tree(tmp_path, {"leaky.py": "def f():\n    print('x')\n"})
+    rc = main(["--root", str(tmp_path)])
+    rec = check_cli_contract_text(capsys.readouterr().out, "lint")
+    assert rc == 1
+    assert rec["ok"] is False and rec["findings_new"] == 1
+
+
+# -- baseline workflow ----------------------------------------------------
+
+
+def test_baseline_accepts_old_debt_and_fails_new(tmp_path, capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    write_tree(tmp_path, {"old.py": "print('pre-existing')\n"})
+    assert main(["--root", str(tmp_path)]) == 1
+    capsys.readouterr()
+    assert main(["--root", str(tmp_path), "--update_baseline"]) == 0
+    capsys.readouterr()
+    # Baselined: clean run, finding classified as accepted debt.
+    assert main(["--root", str(tmp_path)]) == 0
+    rec = json.loads(
+        [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
+    assert rec["findings_baselined"] == 1 and rec["findings_new"] == 0
+    # A NEW violation still fails loudly.
+    (tmp_path / "new.py").write_text("print('fresh debt')\n")
+    assert main(["--root", str(tmp_path)]) == 1
+    rec = json.loads(
+        [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
+    assert rec["findings_new"] == 1 and rec["findings_baselined"] == 1
+
+
+def test_baseline_survives_line_drift_and_reports_stale(tmp_path, capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    write_tree(tmp_path, {"mod.py": "print('kept')\n"})
+    assert main(["--root", str(tmp_path), "--update_baseline"]) == 0
+    capsys.readouterr()
+    # Prepend unrelated lines: the finding MOVES but its fingerprint
+    # (line text, not number) still matches the baseline.
+    (tmp_path / "mod.py").write_text(
+        "import logging\n\nlog = logging.getLogger()\nprint('kept')\n")
+    assert main(["--root", str(tmp_path)]) == 0
+    rec = json.loads(
+        [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
+    assert rec["findings_baselined"] == 1
+    # Fix the violation: run stays green and the entry reports stale.
+    (tmp_path / "mod.py").write_text("import logging\n")
+    assert main(["--root", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    rec = json.loads([ln for ln in out.splitlines() if ln][-1])
+    assert rec["stale_baseline_entries"] == 1
+    assert "stale baseline entry" in out
+
+
+def test_subset_update_keeps_other_rules_baseline(tmp_path, capsys):
+    """--rules X --update_baseline must not wipe rule Y's accepted debt
+    (and a subset run must not call Y's entries stale)."""
+    from deepinteract_tpu.cli.lint import main
+
+    write_tree(tmp_path, {
+        "core.py": "print('accepted noise')\n",
+        "models/bad.py": "import jax.numpy as jnp\nB = jnp.float32\n",
+    })
+    assert main(["--root", str(tmp_path), "--update_baseline"]) == 0
+    capsys.readouterr()
+    # Subset run: the dtype entry is neither new nor stale.
+    assert main(["--root", str(tmp_path), "--rules", "no-print"]) == 0
+    out = capsys.readouterr().out
+    rec = json.loads([ln for ln in out.splitlines() if ln][-1])
+    assert rec["stale_baseline_entries"] == 0
+    assert "stale baseline entry" not in out
+    # Subset update: the dtype entry survives the rewrite.
+    assert main(["--root", str(tmp_path), "--rules", "no-print",
+                 "--update_baseline"]) == 0
+    capsys.readouterr()
+    assert main(["--root", str(tmp_path)]) == 0
+    rec = json.loads(
+        [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
+    assert rec["findings_baselined"] == 2 and rec["findings_new"] == 0
+
+
+def test_baseline_schema_mismatch_fails_loudly(tmp_path):
+    from deepinteract_tpu.analysis import baseline
+
+    p = tmp_path / "LINT_BASELINE.json"
+    p.write_text(json.dumps({"schema_version": 99, "findings": []}))
+    with pytest.raises(ValueError, match="schema_version"):
+        baseline.load(p)
+
+
+# -- rule fixtures: each fires AND respects suppression -------------------
+
+
+def test_no_print_fires_and_suppresses(tmp_path):
+    write_tree(tmp_path, {
+        "core.py": ("def f(log_fn=print):\n"
+                    "    print('leak')\n"
+                    "    print('waived')  # di: allow[no-print] demo\n"),
+        "cli/main.py": "print('sanctioned')\n",
+    })
+    r = findings_of(tmp_path, "no-print")
+    assert [(f.path, f.line) for f in r.findings] == [("core.py", 2)]
+    assert [(f.path, f.line) for f in r.suppressed] == [("core.py", 3)]
+
+
+def test_dtype_discipline_fires_and_suppresses(tmp_path):
+    write_tree(tmp_path, {
+        "models/policy.py": "import jax.numpy as jnp\nF32 = jnp.float32\n",
+        "models/bad.py": (
+            "import jax.numpy as jnp\n"
+            "import jax\n"
+            "def f(x):\n"
+            "    y = x.astype(jnp.float32)\n"
+            "    z = jnp.zeros((2,), jax.numpy.bfloat16)\n"
+            "    name = 'float32'\n"
+            "    # di: allow[dtype-discipline] A/B scaffolding\n"
+            "    w = x.astype(jnp.float16)\n"
+            "    return y, z, name, w\n"),
+    })
+    r = findings_of(tmp_path, "dtype-discipline")
+    assert [(f.path, f.line) for f in r.findings] == [
+        ("models/bad.py", 4), ("models/bad.py", 5)]
+    assert [(f.path, f.line) for f in r.suppressed] == [("models/bad.py", 8)]
+
+
+JIT_FIXTURE = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+@jax.jit
+def hot(x, y):
+    if x > 0:                      # branch on tracer -> finding
+        return float(y)            # concretize -> finding
+    v = x.item()                   # sync -> finding
+    a = np.asarray(y)              # host materialization -> finding
+    if y is None:                  # host-legal None check -> clean
+        return x
+    if x.shape[0] > 4:             # static shape branch -> clean
+        return x
+    b = x.item()  # di: allow[jit-host-sync] demo waiver
+    return v + a + b
+
+@partial(jax.jit, static_argnames=("mode",))
+def routed(x, mode):
+    if mode == "fast":             # static arg -> clean
+        return x * 2
+    return x
+
+def scan_body(carry, x):
+    if carry > 0:                  # scan body branch -> finding
+        return carry, x
+    return carry + x, x
+
+def outer(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+def helper(t):
+    return t.item()                # reached from jitted entry -> finding
+
+@jax.jit
+def entry(t):
+    return helper(t)
+
+def cold(x):
+    return float(np.asarray(x))    # not traced -> clean
+"""
+
+
+def test_jit_host_sync_covers_fori_and_cond_operands(tmp_path):
+    """Function operands live at different positions per lax primitive:
+    fori_loop's body is args[2], cond's branches are args[1:3] — and the
+    predicate at cond's args[0] must NOT mark a same-named function."""
+    write_tree(tmp_path, {"ops/cf.py": (
+        "import jax\n"
+        "def body(i, c):\n"
+        "    return float(c)\n"                       # line 3 -> finding
+        "def false_fn(x):\n"
+        "    return x.item()\n"                       # line 5 -> finding
+        "def flag(x):\n"
+        "    return bool(x)\n"                        # predicate, untraced
+        "def outer(x, pred):\n"
+        "    y = jax.lax.fori_loop(0, 10, body, x)\n"
+        "    return jax.lax.cond(flag, lambda v: v, false_fn, y)\n")})
+    r = findings_of(tmp_path, "jit-host-sync")
+    assert sorted(f.line for f in r.findings) == [3, 5]
+
+
+def test_jit_host_sync_precision_edges(tmp_path):
+    """Builtin map() is not lax.map; call-site static_argnums ints pin
+    params static; ternaries on traced values ARE flagged."""
+    write_tree(tmp_path, {"ops/edges.py": (
+        "import jax\n"
+        "def _to_host(r):\n"
+        "    if r > 0:\n"                         # host helper: clean
+        "        return float(r)\n"
+        "    return 0.0\n"
+        "def collect(results):\n"
+        "    return list(map(_to_host, results))\n"
+        "def step(n_steps, x):\n"
+        "    if n_steps > 2:\n"                   # static argnum 0: clean
+        "        x = x * 2\n"
+        "    y = x if x > 0 else -x\n"            # line 11: ternary -> finding
+        "    return y\n"
+        "step_jit = jax.jit(step, static_argnums=(0,))\n")})
+    r = findings_of(tmp_path, "jit-host-sync")
+    assert sorted(f.line for f in r.findings) == [11]
+    assert "ternary" in r.findings[0].message
+
+
+def test_jit_host_sync_fires_and_suppresses(tmp_path):
+    write_tree(tmp_path, {"ops/hot.py": JIT_FIXTURE})
+    r = findings_of(tmp_path, "jit-host-sync")
+    lines = [(f.line, f.message) for f in r.findings]
+    flagged = sorted(ln for ln, _ in lines)
+    assert 8 in flagged   # if x > 0
+    assert 9 in flagged   # float(y)
+    assert 10 in flagged  # x.item()
+    assert 11 in flagged  # np.asarray(y)
+    assert 26 in flagged  # scan body branch
+    assert 34 in flagged  # helper .item() via call closure
+    clean_lines = {12, 14, 21, 41}  # None-check, shape, static arg, cold
+    assert not clean_lines & set(flagged)
+    assert [f.line for f in r.suppressed] == [16]
+    # Message names the offending construct and the traced function.
+    assert any("`hot`" in m and ".item()" in m for _, m in lines)
+
+
+LOCK_FIXTURE = """\
+import threading
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0            # __init__ is exempt (pre-sharing)
+        self.items = []
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+            self.count += 1
+
+    def racy_read(self):
+        return self.count          # guarded attr, no lock -> finding
+
+    def racy_rmw(self):
+        self.total = 0
+        self.total += 1            # unguarded += in lock-owning class
+
+    def waived(self):
+        return self.items  # di: allow[lock-discipline] caller holds _lock
+
+class NoLock:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1                # no lock owned -> clean
+"""
+
+
+def test_lock_discipline_fires_and_suppresses(tmp_path):
+    write_tree(tmp_path, {"svc.py": LOCK_FIXTURE})
+    r = findings_of(tmp_path, "lock-discipline")
+    by_line = {f.line: f.message for f in r.findings}
+    assert 15 in by_line and "count" in by_line[15]
+    assert 19 in by_line and "read-modify-write" in by_line[19]
+    assert all(ln not in by_line for ln in (6, 7, 29))  # init + NoLock
+    assert [f.line for f in r.suppressed] == [22]
+
+
+def test_lock_names_are_anchored_not_substrings(tmp_path):
+    """A non-lock context manager whose name merely CONTAINS 'lock'
+    (self._blocker) must not turn the class into a lock-owner."""
+    write_tree(tmp_path, {"cm.py": (
+        "class C:\n"
+        "    def work(self, x):\n"
+        "        with self._blocker:\n"
+        "            self.items.append(x)\n"
+        "    def read(self):\n"
+        "        return self.items\n")})
+    r = findings_of(tmp_path, "lock-discipline")
+    assert r.findings == []
+
+
+PRNG_FIXTURE = """\
+import jax
+
+def reused(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))   # reuse -> finding
+    return a + b
+
+def disciplined(seed):
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub = jax.random.split(key)    # parent re-split after rebind
+    b = jax.random.uniform(sub, (4,))
+    return a + b
+
+def split_then_reuse(seed):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(key, (4,))    # parent used AFTER split -> finding
+    return a + jax.random.normal(k1, ()) + jax.random.normal(k2, ())
+
+def waived(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    # di: allow[prng-key-reuse] demo waiver
+    b = jax.random.normal(key, (4,))
+    return a + b
+"""
+
+
+def test_prng_reuse_fires_and_suppresses(tmp_path):
+    write_tree(tmp_path, {"keys.py": PRNG_FIXTURE})
+    r = findings_of(tmp_path, "prng-key-reuse")
+    flagged = sorted(f.line for f in r.findings)
+    assert flagged == [6, 20]
+    assert [f.line for f in r.suppressed] == [27]
+    assert all("disciplined" not in f.message for f in r.findings)
+
+
+def test_prng_batch_split_indexing_is_clean(tmp_path):
+    """`keys = split(key, n)` then keys[0]/keys[1] is the canonical
+    batch-split idiom — distinct subkeys, never reuse."""
+    write_tree(tmp_path, {"batch.py": (
+        "import jax\n"
+        "def f(seed):\n"
+        "    keys = jax.random.split(jax.random.PRNGKey(seed), 3)\n"
+        "    a = jax.random.normal(keys[0], (4,))\n"
+        "    b = jax.random.normal(keys[1], (4,))\n"
+        "    c = jax.random.normal(keys[2], (4,))\n"
+        "    return a + b + c\n")})
+    r = findings_of(tmp_path, "prng-key-reuse")
+    assert r.findings == []
+
+
+def test_prng_key_parameter_reuse_is_caught(tmp_path):
+    """A key RECEIVED as a parameter and consumed twice fires; a CACHE
+    key parameter (function never touches jax.random) stays clean."""
+    write_tree(tmp_path, {"param.py": (
+        "import jax\n"
+        "def f(dropout_rng):\n"
+        "    a = jax.random.normal(dropout_rng, (2,))\n"
+        "    b = jax.random.uniform(dropout_rng, (2,))\n"
+        "    return a + b\n"
+        "def cache_get(self, key):\n"
+        "    probe(key)\n"
+        "    return fetch(key)\n"
+        "def delegated(model_rng):\n"
+        "    a = helper_a(model_rng)\n"
+        "    b = helper_b(model_rng)\n"     # line 11: strong-named reuse
+        "    return a + b\n")})
+    r = findings_of(tmp_path, "prng-key-reuse")
+    assert sorted(f.line for f in r.findings) == [4, 11]
+    assert any("dropout_rng" in f.message for f in r.findings)
+
+
+CLI_FIXTURE_ARGS = """\
+def add_args(p):
+    g = p.add_argument_group("x")
+    g.add_argument("--used_flag", type=int, default=1)
+    g.add_argument("--dict_flag", type=int, default=2)
+    g.add_argument("--dead_flag", type=int, default=0)
+    g.add_argument("--waived_flag", type=int)  # di: allow[dead-cli-flag] future surface
+    g.add_argument("--renamed", dest="real_dest", action="store_true")
+"""
+
+CLI_FIXTURE_MAIN = """\
+def main(args):
+    if args.used_flag:
+        return getattr(args, "real_dest")
+    return 0
+
+def dictly(args):
+    return vars(args)["dict_flag"]
+"""
+
+
+def test_dead_cli_flag_fires_and_suppresses(tmp_path):
+    write_tree(tmp_path, {"cli/args.py": CLI_FIXTURE_ARGS,
+                          "cli/train.py": CLI_FIXTURE_MAIN})
+    r = findings_of(tmp_path, "dead-cli-flag")
+    assert [(f.path, f.line) for f in r.findings] == [("cli/args.py", 5)]
+    assert "--dead_flag" in r.findings[0].message  # vars(args)['dict_flag'] counts as a read
+    assert [f.line for f in r.suppressed] == [6]
+
+
+def test_dead_cli_flag_registration_default_does_not_self_mask(tmp_path):
+    """`add_argument("--x", default=cfg.x)` must not count cfg.x as a
+    read of the dest — exactly the flags wired only to a config default
+    are the likely-dead ones."""
+    write_tree(tmp_path, {"cli/args.py": (
+        "def add_args(p, cfg):\n"
+        "    p.add_argument('--self_masked', default=cfg.self_masked)\n")})
+    r = findings_of(tmp_path, "dead-cli-flag")
+    assert [f.line for f in r.findings] == [2]
+    assert "--self_masked" in r.findings[0].message
+
+
+# -- shim parity ----------------------------------------------------------
+
+
+def _shim_locations(lines, root):
+    out = set()
+    for ln in lines:
+        path, line, _ = ln.split(":", 2)
+        out.add((pathlib.Path(path).relative_to(root).as_posix(),
+                 int(line)))
+    return out
+
+
+def test_no_print_shim_matches_framework_rule(tmp_path):
+    from tools.check_no_print import iter_violations
+
+    write_tree(tmp_path, {
+        "core.py": "print('leak')\n",
+        "sub/deep.py": "def f():\n    print('nested')\n",
+        "cli/main.py": "print('sanctioned')\n",
+    })
+    shim = _shim_locations(iter_violations(tmp_path), tmp_path)
+    rule = findings_of(tmp_path, "no-print")
+    framework = {(f.path, f.line)
+                 for f in rule.findings + rule.suppressed}
+    assert shim == framework == {("core.py", 1), ("sub/deep.py", 2)}
+
+
+def test_no_print_shim_clean_on_repo():
+    """Shim and framework agree on the real repo (both empty — PR-3
+    found zero violations and the rule keeps it that way)."""
+    from tools.check_no_print import iter_violations
+
+    shim = list(iter_violations(REPO / "deepinteract_tpu"))
+    rule = run_rules(REPO, rule_names=["no-print"])
+    assert shim == [] and rule.findings == []
+
+
+def test_dtype_shim_matches_framework_rule(tmp_path):
+    from tools.check_dtype_discipline import iter_violations
+
+    write_tree(tmp_path, {
+        "models/policy.py": "import jax.numpy as jnp\nOK = jnp.float32\n",
+        "models/bad.py": ("import jax.numpy as jnp\n"
+                          "BAD = jnp.bfloat16\n"),
+    })
+    shim = _shim_locations(
+        iter_violations(tmp_path / "models"), tmp_path / "models")
+    rule = findings_of(tmp_path, "dtype-discipline")
+    framework = {(f.path.removeprefix("models/"), f.line)
+                 for f in rule.findings + rule.suppressed}
+    assert shim == framework == {("bad.py", 2)}
+
+
+def test_dtype_shim_clean_on_repo():
+    from tools.check_dtype_discipline import iter_violations
+
+    shim = list(iter_violations(REPO / "deepinteract_tpu" / "models"))
+    rule = run_rules(REPO, rule_names=["dtype-discipline"])
+    assert shim == [] and rule.findings == []
+
+
+# -- engine mechanics ------------------------------------------------------
+
+
+def test_unknown_rule_is_a_usage_error(tmp_path, capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    assert main(["--root", str(tmp_path), "--rules", "nope"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_file_root_is_rejected_not_falsely_clean(tmp_path, capsys):
+    """A file --root would dodge every path-scoped rule and report a
+    bogus clean run — refused with a usage error instead."""
+    from deepinteract_tpu.cli.lint import main
+
+    f = tmp_path / "one.py"
+    f.write_text("print('x')\n")
+    assert main(["--root", str(f)]) == 2
+    assert "directory" in capsys.readouterr().err
+
+
+def test_parse_failure_fails_the_run(tmp_path, capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    write_tree(tmp_path, {"broken.py": "def f(:\n"})
+    rc = main(["--root", str(tmp_path)])
+    rec = check_cli_contract_text(capsys.readouterr().out, "lint")
+    assert rc == 1 and rec["parse_failures"] == 1
+
+
+def test_undecodable_file_is_a_parse_failure_not_a_crash(tmp_path, capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    (tmp_path / "latin.py").write_bytes(b"# caf\xe9\nx = 1\n")
+    rc = main(["--root", str(tmp_path)])
+    rec = check_cli_contract_text(capsys.readouterr().out, "lint")
+    assert rc == 1 and rec["parse_failures"] == 1
+
+
+def test_rule_selection_runs_subset(tmp_path, capsys):
+    from deepinteract_tpu.cli.lint import main
+
+    write_tree(tmp_path, {"core.py": "print('leak')\n"})
+    rc = main(["--root", str(tmp_path), "--rules", "lock-discipline"])
+    rec = check_cli_contract_text(capsys.readouterr().out, "lint")
+    assert rc == 0 and rec["rules"] == ["lock-discipline"]
+
+
+def test_allow_all_pragma(tmp_path):
+    write_tree(tmp_path, {
+        "core.py": "print('x')  # di: allow[all] bootstrap banner\n"})
+    r = findings_of(tmp_path, "no-print")
+    assert r.findings == [] and len(r.suppressed) == 1

@@ -57,6 +57,15 @@ CONTRACTS = {
                      "compared", "regressions"),
         "numeric": ("value", "compared"),
     },
+    # lint/v1: python -m deepinteract_tpu.cli.lint (the unified static-
+    # analysis run; deepinteract_tpu/analysis).
+    "lint": {
+        "required": ("schema", "metric", "value", "unit", "ok", "rules",
+                     "files_scanned", "findings_total", "findings_new",
+                     "findings_baselined", "suppressed", "baseline"),
+        "numeric": ("value", "files_scanned", "findings_total",
+                    "findings_new", "findings_baselined", "suppressed"),
+    },
 }
 
 

@@ -1,20 +1,16 @@
 """Static check: no hardcoded float dtypes in ``models/`` outside
 ``models/policy.py``.
 
-The dtype policy (``deepinteract_tpu/models/policy.py``) is the single
-place model code may name a precision: statistics accumulate in
-``STATS_DTYPE``, outward-facing tensors are ``OUTPUT_DTYPE``, activations
-follow the configured compute dtype. A stray ``jnp.float32`` cast inside
-a model silently pins part of the graph to full precision (the pre-r6
-decoder had exactly such islands, which neutralized bf16 until they were
-hunted down one by one) — or worse, a stray ``jnp.bfloat16`` bypasses the
-policy's float32 guarantees for params/norms/logits.
+Thin shim over the framework rule
+:mod:`deepinteract_tpu.analysis.rules.dtype_discipline` (the
+``hlo_probe.py`` precedent: the implementation moved into the package so
+one ``python -m deepinteract_tpu.cli.lint`` run covers the whole repo;
+this entry point keeps the historical CLI and exit-code contract). The
+dtype policy (``deepinteract_tpu/models/policy.py``) is the single place
+model code may name a precision — stray ``jnp.float32`` casts are the
+"f32 islands" that neutralized bf16 in the pre-r6 decoder.
 
-AST-based (not grep): only real attribute references to the dtype names
-on the ``jnp`` / ``np`` / ``jax.numpy`` / ``numpy`` modules count —
-strings mentioning 'float32' (config values like
-``compute_dtype="float32"``) and comparisons against those strings do
-not. Run directly or via the fast-tier test
+Run directly or via the fast-tier test
 ``tests/test_dtype_discipline.py``::
 
     python tools/check_dtype_discipline.py        # exit 1 + report
@@ -25,28 +21,17 @@ from __future__ import annotations
 
 import argparse
 import ast
+import os
 import pathlib
 import sys
 from typing import Iterator
 
-# Files inside the scanned root where naming a dtype is the point.
-ALLOWED_FILES = {"policy.py"}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Forbidden attribute names on a numpy-ish module object.
-DTYPE_ATTRS = {"float32", "bfloat16", "float16", "float64"}
-
-# Module aliases whose dtype attributes count as hardcoding.
-NUMPY_MODULES = {"jnp", "np", "numpy"}
-
-
-def _is_numpy_module(node: ast.expr) -> bool:
-    """True for ``jnp`` / ``np`` / ``numpy`` names and ``jax.numpy``."""
-    if isinstance(node, ast.Name):
-        return node.id in NUMPY_MODULES
-    if isinstance(node, ast.Attribute):  # jax.numpy
-        return (isinstance(node.value, ast.Name)
-                and node.value.id == "jax" and node.attr == "numpy")
-    return False
+from deepinteract_tpu.analysis.rules.dtype_discipline import (  # noqa: E402
+    ALLOWED_FILES,
+    violations_in_tree,
+)
 
 
 def iter_violations(models_root: pathlib.Path) -> Iterator[str]:
@@ -59,15 +44,8 @@ def iter_violations(models_root: pathlib.Path) -> Iterator[str]:
         except SyntaxError as exc:
             yield f"{path}:{exc.lineno or 0}: unparseable ({exc.msg})"
             continue
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Attribute)
-                    and node.attr in DTYPE_ATTRS
-                    and _is_numpy_module(node.value)):
-                yield (f"{path}:{node.lineno}: hardcoded dtype "
-                       f"'{ast.unparse(node)}' — import it from "
-                       "models/policy.py (STATS_DTYPE / OUTPUT_DTYPE / "
-                       "FLOAT32 / compute_dtype()) so precision has one "
-                       "authority")
+        for line, message in violations_in_tree(tree):
+            yield f"{path}:{line}: {message}"
 
 
 def main(argv=None) -> int:

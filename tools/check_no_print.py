@@ -1,16 +1,15 @@
 """Static check: no bare ``print(`` in ``deepinteract_tpu/`` outside ``cli/``.
 
-Library, training, serving, and pipeline code must report through
-``logging`` or the telemetry registry (``deepinteract_tpu/obs``) so output
-is structured, filterable, and visible to exposition — a stray print
-bypasses all three and disappears in multi-host runs. The CLI entry
-points (``deepinteract_tpu/cli/``) and the top-level ``bench.py`` are the
-sanctioned stdout surfaces and are exempt.
+Thin shim over the framework rule
+:mod:`deepinteract_tpu.analysis.rules.no_print` (the ``hlo_probe.py``
+precedent: the implementation moved into the package so one
+``python -m deepinteract_tpu.cli.lint`` run covers the whole repo; this
+entry point keeps the historical CLI and exit-code contract). Library,
+training, serving, and pipeline code must report through ``logging`` or
+the telemetry registry (``deepinteract_tpu/obs``) — a stray print
+bypasses both and disappears in multi-host runs.
 
-AST-based (not grep): only real ``print(...)`` *calls* to the builtin
-name count — ``log_fn=print`` defaults, methods named print, and strings
-mentioning print() do not. Run directly or via the fast-tier test
-``tests/test_no_print.py``::
+Run directly or via the fast-tier test ``tests/test_no_print.py``::
 
     python tools/check_no_print.py            # exit 1 + report on violation
     python tools/check_no_print.py --root path/to/package
@@ -20,11 +19,19 @@ from __future__ import annotations
 
 import argparse
 import ast
+import os
 import pathlib
 import sys
 from typing import Iterator
 
-# Package subdirectories where bare print() is the intended UX.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepinteract_tpu.analysis.rules.no_print import (  # noqa: E402
+    violations_in_tree,
+)
+
+# Package subdirectories where bare print() is the intended UX (the
+# historical shim semantics: scan a package root, exempt cli/).
 ALLOWED_FIRST_PARTS = {"cli"}
 
 
@@ -39,12 +46,8 @@ def iter_violations(package_root: pathlib.Path) -> Iterator[str]:
         except SyntaxError as exc:
             yield f"{path}:{exc.lineno or 0}: unparseable ({exc.msg})"
             continue
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "print"):
-                yield (f"{path}:{node.lineno}: bare print() — use logging "
-                       "or the obs registry (cli/ and bench.py are exempt)")
+        for line, message in violations_in_tree(tree):
+            yield f"{path}:{line}: {message}"
 
 
 def main(argv=None) -> int:
