@@ -426,6 +426,18 @@ def bench_bucket(model, state, batch, label, detail, remat, scan_k,
                     "untrustworthy: differenced protocol degenerate "
                     f"({proto_key}: clamped={proto['clamped_samples']}, "
                     f"linearity={proto['linearity']:.2f})")
+    # Unstable-sample flag (ISSUE-10 satellite): the shared timing core
+    # marks protocols whose linearity is outside the healthy band or
+    # whose reps disagree (BENCH_r05 shipped headline numbers at
+    # linearity 1.53-1.93 without comment). Lift the warning to the
+    # entry level so it rides into the section detail — and, for the
+    # headline bucket, into the contract line (_build_headline) where
+    # tools/check_perf_regression.py widens its tolerance for it.
+    for proto_key in ("timing_protocol", "scan_timing_protocol"):
+        proto = entry.get(proto_key)
+        if proto and proto.get("timing_warning"):
+            entry.setdefault("timing_warnings", []).append(
+                f"{proto_key}: {proto['timing_warning']}")
     _log(json.dumps({label: entry}))
     _dump_partial(detail)
     return entry
@@ -639,21 +651,37 @@ def _run_inline_ab(bucket_entry, state, batch, ctx, detail) -> None:
     """Pallas-vs-jnp A/B folded into the headline section (VERDICT r4
     item 1): the bucket's own 'auto' measurements ARE the Pallas side
     (auto = Pallas wherever supported — see GTConfig.attention_impl), so
-    only the jnp-forced forward + train step compile here. The bucket's
+    only the jnp-forced forward + train steps compile here. The bucket's
     train state is reused via ``state.replace(apply_fn=...)`` — the
     forced model shares its exact param tree, and a fresh
     ``create_train_state`` would pay another init compile through the
     tunnel. Halves skip with a recorded reason when the parent's section
     deadline is too close (the r5 rehearsal lost the A/B to the section
-    timeout)."""
+    timeout).
+
+    Gen-2 additions (ISSUE-10): the jnp side also measures the SCANNED
+    train step — single-dispatch numbers carry ±10-20% tunnel spread
+    (BASELINE.md) and cannot decide routing, so ``pallas_speedup_
+    train_scan`` is the decision-grade ratio — and, when DI_ATTENTION_AB
+    points at an evidence file, the measured speedups are recorded there
+    so ``attention_impl='auto'`` demonstrably falls back to jnp on
+    buckets where the kernel loses (ops/pallas_attention.py:
+    resolve_attention_impl)."""
     import jax
 
-    from deepinteract_tpu.training.steps import train_step
+    from deepinteract_tpu.training.steps import (
+        multi_train_step,
+        stack_microbatches,
+        train_step,
+    )
 
     ab = {"note": ("pallas-side numbers reused from the b1_p128 bucket "
-                   "(auto = pallas); jnp side forced"),
+                   "(auto = pallas); jnp side forced. train_scan is the "
+                   "decision-grade ratio (scanned dispatch)"),
           "pallas": {"forward_ms": bucket_entry.get("forward_ms"),
-                     "train_ms": bucket_entry.get("train_ms")}}
+                     "train_ms": bucket_entry.get("train_ms"),
+                     "train_scan_ms_per_step":
+                         bucket_entry.get("train_scan_ms_per_step")}}
     try:
         m_jnp = ctx["make_model"](attention_impl="jnp")
         if _child_time_left() < 120:
@@ -678,17 +706,62 @@ def _run_inline_ab(bucket_entry, state, batch, ctx, detail) -> None:
             tstep = jax.jit(lambda s, b: train_step(s, b))
             _, tt, _ = _time_compiled(tstep, (s_jnp, batch))
             ab["jnp"]["train_ms"] = tt["median"] * 1e3
+            _dump_partial(detail)
+            # jnp scanned train (decision-grade half, ISSUE-10): same
+            # protocol as the bucket's own scan measurement.
+            scan_k = ctx["scan_k"]
+            if (_child_time_left() >= 180
+                    and bucket_entry.get("train_scan_ms_per_step")):
+                stacked = stack_microbatches([batch] * scan_k)
+                mstep = jax.jit(lambda s, bst: multi_train_step(s, bst))
+                _, mt, _ = _time_compiled(
+                    mstep, (s_jnp, stacked),
+                    iters=max(ITERS // 4, 3), reps=min(REPS, 3))
+                ab["jnp"]["train_scan_ms_per_step"] = (
+                    mt["median"] * 1e3 / scan_k)
         if ab["jnp"].get("forward_ms") and ab["pallas"].get("forward_ms"):
             ab["pallas_speedup_forward"] = (
                 ab["jnp"]["forward_ms"] / ab["pallas"]["forward_ms"])
         if ab["jnp"].get("train_ms") and ab["pallas"].get("train_ms"):
             ab["pallas_speedup_train"] = (
                 ab["jnp"]["train_ms"] / ab["pallas"]["train_ms"])
+        if (ab["jnp"].get("train_scan_ms_per_step")
+                and ab["pallas"].get("train_scan_ms_per_step")):
+            ab["pallas_speedup_train_scan"] = (
+                ab["jnp"]["train_scan_ms_per_step"]
+                / ab["pallas"]["train_scan_ms_per_step"])
+        _record_attention_evidence(ab, 1, 128, ctx["bench_dtype"])
     except Exception as exc:
         ab["error"] = str(exc).splitlines()[0][:300] if str(exc) else repr(exc)
     detail["attention_ab_b1_p128"] = ab
     _log(json.dumps({"attention_ab_b1_p128": ab}))
     _dump_partial(detail)
+
+
+def _record_attention_evidence(ab, batch, pad, dtype) -> None:
+    """Persist measured Pallas-vs-jnp speedups into the DI_ATTENTION_AB
+    evidence file (when set) so auto routing can demote the kernel on
+    buckets where it measurably lost — the autotune guard that makes the
+    BENCH_r05 0.97x forward default unshippable (ISSUE-10)."""
+    from deepinteract_tpu.ops.pallas_attention import (
+        attention_ab_path,
+        record_attention_ab,
+    )
+
+    path = attention_ab_path()
+    if not path:
+        return
+    speedups = {k: ab[k] for k in ("pallas_speedup_forward",
+                                   "pallas_speedup_train",
+                                   "pallas_speedup_train_scan") if k in ab}
+    if not speedups:
+        return
+    record_attention_ab(
+        path, batch, pad, dtype,
+        forward_speedup=speedups.get("pallas_speedup_forward"),
+        train_speedup=speedups.get("pallas_speedup_train"),
+        train_scan_speedup=speedups.get("pallas_speedup_train_scan"))
+    ab["evidence_recorded"] = path
 
 
 def _run_ab_section(pad: int, ctx, detail) -> None:
@@ -1244,6 +1317,26 @@ def _build_headline(detail, scan_k) -> dict:
             entry["train_complexes_per_sec"], 2)
     if "analytic_train_mfu" in entry:
         line["analytic_train_mfu"] = round(entry["analytic_train_mfu"], 4)
+    if entry.get("timing_warnings"):
+        # The headline was measured under an unstable differenced
+        # protocol — say so in the contract itself so the regression
+        # gate (tools/check_perf_regression.py) widens its tolerance
+        # instead of trusting a noisy figure at face value.
+        line["timing_warning"] = "; ".join(entry["timing_warnings"])
+    ab = detail.get("attention_ab_b1_p128", {})
+    if isinstance(ab, dict) and any(k.startswith("pallas_speedup")
+                                    for k in ab):
+        # The Pallas-vs-jnp A/B rides in the contract line (ISSUE-10
+        # acceptance): the scanned ratio is the decision-grade one; the
+        # evidence_recorded path says auto-routing was fed the result.
+        line["attention_ab"] = {
+            k: round(ab[k], 4) for k in ("pallas_speedup_forward",
+                                         "pallas_speedup_train",
+                                         "pallas_speedup_train_scan")
+            if isinstance(ab.get(k), (int, float))}
+        if "evidence_recorded" in ab:
+            line["attention_ab"]["evidence_recorded"] = (
+                ab["evidence_recorded"])
     attribution = detail.get("attribution", {})
     if "top_ops" in attribution:
         # Device-time attribution of the serving forward (ISSUE-8): the

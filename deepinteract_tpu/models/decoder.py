@@ -224,25 +224,31 @@ class InstanceNorm(nn.Module):
         return masked_instance_norm(x, mask, scale, bias)
 
 
-class PVConv1x1(nn.Module):
-    """1x1 conv that also maps the tracked pad value through the same
-    parameters.
+class BiasConv1x1(nn.Module):
+    """1x1 conv whose tracked pad value is its own bias — the r10
+    replacement for the r5 pad-value matvec machinery (``PVConv1x1``).
+
+    Contract: the caller guarantees every padded pixel of ``x`` is ZERO
+    (the fast path fuses the zeroing multiply into the preceding elu, so
+    it rides an elementwise pass that already exists). A 1x1 conv of a
+    zero pixel is then exactly its bias, so the pad value out is the bias
+    parameter broadcast to [1, 1, 1, O] — closed form, no data-dependent
+    work. The r5 design instead tracked an arbitrary [B, 1, 1, C] pad
+    value through a broadcast-multiply + sum of the conv kernel; those
+    tiny contractions cost a ~24 us launch each on a v5e and the 112 of
+    them per decoder forward were the top re-mask-class sink in the PR-7
+    attribution census (`python -m deepinteract_tpu.cli.attribute
+    --census decoder`) — this class deletes them outright.
 
     Param tree is identical to ``nn.Conv(features, (1, 1))`` (kernel
     [1, 1, I, O] lecun-normal, bias [O] zeros) — checkpoints are
-    interchangeable. The map goes through the real conv; the [B, 1, 1, C]
-    pad value goes through a broadcast-multiply + sum formulation of the
-    same affine, which XLA fuses into a tiny reduce instead of paying a
-    full conv/dot kernel launch (~24 us each on a v5e — 112 of them per
-    decoder forward measurably dominated the depad path's overhead;
-    measure with `python -m deepinteract_tpu.cli.attribute --census
-    decoder` over a --profile_dir capture)."""
+    interchangeable."""
 
     features: int
     dtype: Any = FLOAT32
 
     @nn.compact
-    def __call__(self, x, pv=None):
+    def __call__(self, x):
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(),
             (1, 1, x.shape[-1], self.features))
@@ -252,11 +258,7 @@ class PVConv1x1(nn.Module):
         y = jax.lax.conv_general_dilated(
             x.astype(self.dtype), k, (1, 1), "VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
-        if pv is None:
-            return y, None
-        pv_out = jnp.sum(pv.astype(self.dtype)[..., :, None] * k[0, 0],
-                         axis=-2) + b
-        return y, pv_out
+        return y, b[None, None, None, :]
 
 
 class SEBlock(nn.Module):
@@ -308,20 +310,25 @@ class BottleneckBlock(nn.Module):
     ``depad`` selects the pad-value-tracking fast path (requires mask,
     count AND an incoming ``pad_value``): instead of re-zeroing the padded
     region after every op, the block tracks the single per-channel value
-    all padded pixels hold ([B, 1, 1, C]) and pushes it through each op in
-    closed form — elementwise ops (elu, norm affine, SE gate, residual
-    add) apply to it directly and a 1x1 conv maps it through the SAME conv
-    module (a [B, 1, 1, C] call reusing the parameters). Every statistic
-    then runs as an UNMASKED reduction with a closed-form pad correction.
-    The only places the mask is materialized are the two multiplies around
-    the spatially-mixing 3x3 conv: before it (so padded pixels enter the
-    conv as zero — the reference's unpadded zero-boundary behavior) and
-    after it (the boundary band mixes valid values, so re-zeroing restores
-    a known pad value and makes inorm_3's sums unmasked-exact). That cuts
-    the r4 fast path's per-block mask traffic (two full-channel + two
-    half-channel passes plus a masked reduction) to two half-channel
-    passes. Statistics are identical up to float association
-    (padding-invariance tests are the oracle).
+    all padded pixels hold and pushes it through each op in closed form,
+    so every statistic runs as an UNMASKED reduction with a closed-form
+    pad correction.
+
+    r10 revision (the attribution burn-down, ROADMAP item 2): the r5
+    design pushed an arbitrary [B, 1, 1, C] pad value through each 1x1
+    conv as a tiny matvec — 112 such launches per decoder forward, the
+    top re-mask-class sink in the PR-7 census×time reconciliation. Now
+    the invariant is "every conv sees ZERO padded pixels": the zeroing
+    multiply is fused into the elu that already precedes each conv (a
+    mask broadcast riding an existing elementwise pass — no extra kernel,
+    unlike the r4 standalone re-masks), so a 1x1 conv's pad value out is
+    just its bias (:class:`BiasConv1x1`, param-only) and the only
+    data-dependent pad values left are the norm affines and the SE gate —
+    pure fused elementwise arithmetic on [B, 1, 1, C]. The mask
+    materializes in four FUSED multiplies per inorm block (after each of
+    the three norms' elu and after the 3x3's boundary mixing) instead of
+    the r5 two-plus-112-matvecs. Statistics are identical up to float
+    association (padding-invariance tests are the oracle).
 
     Fast path returns ``(out, pad_value_out)``; plain path returns the
     masked tensor as before."""
@@ -348,18 +355,24 @@ class BottleneckBlock(nn.Module):
                     x, mask, count=count, pad_value=pv, depad=True)
             else:
                 x = InstanceNorm(self.channels, name="inorm_1")(x, mask)
-        x = nn.elu(x)
         if fast:
-            pv = nn.elu(pv)
-            x, pv = PVConv1x1(half, dtype=self.dtype, name="conv2d_1")(x, pv)
+            # Zero the pad in the SAME elementwise pass as the elu: the
+            # 1x1 then sees zero pads and its pad value out is its bias
+            # (BiasConv1x1) — no pad-value matvec.
+            x = nn.elu(x) * mask[..., None].astype(x.dtype)
+            x, pv = BiasConv1x1(half, dtype=self.dtype, name="conv2d_1")(x)
             x = _tag_conv(x, tag)
             if self.use_inorm:
-                x, pv = InstanceNorm(half, name="inorm_2")(
+                # The post-norm pad value is discarded: the pre-3x3 mask
+                # below re-zeroes the pad anyway. Only the STATISTICS
+                # correction needs ``pv`` (= conv2d_1's bias).
+                x, _ = InstanceNorm(half, name="inorm_2")(
                     x, mask, count=count, pad_value=pv, depad=True)
-            # Mask 1 of 2: the dilated 3x3 must see the reference's zero
-            # boundary, so the padded region is zeroed right before it.
+            # The dilated 3x3 must see the reference's zero boundary, so
+            # the padded region is zeroed right before it (fused, again).
             x = nn.elu(x) * mask[..., None].astype(x.dtype)
         else:
+            x = nn.elu(x)
             x = _tag_conv(
                 nn.Conv(half, (1, 1), dtype=self.dtype, name="conv2d_1")(x),
                 tag)
@@ -379,19 +392,23 @@ class BottleneckBlock(nn.Module):
             padding=self.dilation, dtype=self.dtype, name="conv2d_2",
         )(x), tag)
         if fast:
-            # Mask 2 of 2: the 3x3 mixed valid values into the boundary
-            # band of the pad, so the pad value is no longer uniform;
-            # re-zeroing restores pad_value == 0 and makes the following
-            # statistics unmasked-exact.
+            # The 3x3 mixed valid values into the boundary band of the
+            # pad, so the pad value is no longer uniform; re-zeroing
+            # restores pad_value == 0 and makes inorm_3's statistics
+            # unmasked-exact (n_pad * 0 correction).
             x = x * mask[..., None].astype(x.dtype)
-            pv = jnp.zeros_like(x[:, :1, :1, :])
             if self.use_inorm:
-                x, pv = InstanceNorm(half, name="inorm_3")(
-                    x, mask, count=count, pad_value=pv, depad=True)
-            x = nn.elu(x)
-            pv = nn.elu(pv)
-            x, pv = PVConv1x1(self.channels, dtype=self.dtype,
-                              name="conv2d_3")(x, pv)
+                x, _ = InstanceNorm(half, name="inorm_3")(
+                    x, mask, count=count,
+                    pad_value=jnp.zeros_like(x[:, :1, :1, :]), depad=True)
+                # The norm affine re-filled the pad; zero it in the elu
+                # pass so conv2d_3's pad value is its bias.
+                x = nn.elu(x) * mask[..., None].astype(x.dtype)
+            else:
+                # Pads are exactly zero and elu(0) == 0 — no mask needed.
+                x = nn.elu(x)
+            x, pv = BiasConv1x1(self.channels, dtype=self.dtype,
+                                name="conv2d_3")(x)
             x = _tag_conv(x, tag)
             x, pv = SEBlock(self.channels, dtype=self.dtype, name="se_block")(
                 x, mask, count=count, pad_value=pv)
@@ -475,12 +492,18 @@ class DilatedResNet(nn.Module):
         tag = self.remat and self.remat_policy == "convs"
         pv = pad_value if depad else None
         if self.initial_projection:
-            # Tracks the pad value through the projection in fused
-            # broadcast-sum form instead of re-masking the map.
-            x, pv_out = PVConv1x1(self.channels, dtype=self.dtype,
-                                  name="init_proj")(x, pv)
+            # Depad contract (r10): the caller zeroed the pad in the
+            # preceding fused elu pass, so the projection's pad value out
+            # is its bias (BiasConv1x1) — no pad-value matvec. In the
+            # plain masked mode the bias pad value is simply unused.
+            x, pv_out = BiasConv1x1(self.channels, dtype=self.dtype,
+                                    name="init_proj")(x)
             if depad:
-                pv = pv_out
+                # Concrete [B, 1, 1, C]: the chunk scan carries the pad
+                # value, and scan carries must keep a stable shape across
+                # iterations (blocks return batch-dependent pad values).
+                pv = jnp.broadcast_to(
+                    pv_out, (x.shape[0], 1, 1, self.channels))
         if self.scan_chunks and self.num_chunks > 1:
             # Compile ONE cycle, run it num_chunks times: params stack on a
             # leading [num_chunks] axis under 'chunks/'. ``in_axes=
@@ -605,15 +628,18 @@ class InteractionDecoder(nn.Module):
         x = PairStem1x1(cfg.num_channels, dtype=dt,
                         name="conv2d_1")(pair_tensor)
         if depad:
-            # The ONE entry mask: the incoming pair tensor's padded pixels
-            # are arbitrary (GT features of padded nodes), so zero them
-            # once here — every later op tracks the pad value in closed
-            # form instead of re-masking (see BottleneckBlock).
+            # Entry mask: the incoming pair tensor's padded pixels are
+            # arbitrary (GT features of padded nodes), so zero them here —
+            # every later op tracks the pad value in closed form instead
+            # of re-masking (see BottleneckBlock).
             x = x * mask[..., None].astype(x.dtype)
             pv = jnp.zeros_like(x[:, :1, :1, :])
-            x, pv = InstanceNorm(cfg.num_channels, name="inorm_1")(
+            x, _ = InstanceNorm(cfg.num_channels, name="inorm_1")(
                 x, mask, count=count, pad_value=pv, depad=True)
-            x, pv = nn.elu(x), nn.elu(pv)
+            # Zero the pad again in the elu pass (fused): base_resnet's
+            # initial projection then sees zero pads and its pad value
+            # out is its bias (BiasConv1x1 contract, r10).
+            x = nn.elu(x) * mask[..., None].astype(x.dtype)
         else:
             x = nn.elu(InstanceNorm(cfg.num_channels, name="inorm_1")(x, mask))
 
@@ -623,8 +649,14 @@ class InteractionDecoder(nn.Module):
             scan_chunks=cfg.scan_chunks, dtype=dt, depad=cfg.depad_stats,
             remat_policy=cfg.remat_policy, name="base_resnet",
         )(x, mask, count, pv)
-        x = nn.elu(x)
-        pv = nn.elu(pv) if pv is not None else None
+        if pv is not None:
+            # Inter-stage handoff under depad: zero the pad in the elu
+            # pass so phase2's initial projection keeps the zero-pads-in
+            # contract.
+            x = nn.elu(x) * mask[..., None].astype(x.dtype)
+            pv = jnp.zeros_like(pv)
+        else:
+            x = nn.elu(x)
         if cfg.use_attention:
             x = nn.elu(RegionalAttention(
                 cfg.num_channels, num_heads=cfg.num_attention_heads,

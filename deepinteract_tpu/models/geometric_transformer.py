@@ -71,11 +71,14 @@ class GTConfig:
     # measured divergence on realistic asymmetric kNN graphs.
     attention_mode: str = "scatter"  # 'scatter' (reference-exact) | 'gather' (TPU-fast)
     # 'auto': use the Pallas fused kernel (ops/pallas_attention.py) on TPU
-    # for scatter mode wherever the kernel supports the (batch, bucket)
-    # shape, jnp elsewhere — measured policy: the kernel wins the forward
-    # 1.18-2.06x and the scanned train step 1.02x (f32) / 1.14x (bf16)
-    # (r4/r5 A/B incl. tools/scan_ab.py, BASELINE.md). 'jnp'/'pallas'
-    # force one path ('pallas' still falls back on unsupported buckets).
+    # for scatter mode wherever (a) the gen-2 kernel supports the
+    # (bucket, dtype) shape and (b) the measured A/B evidence store
+    # (DI_ATTENTION_AB, written by tools/scan_ab.py / bench's inline A/B)
+    # does not record the kernel LOSING for the bucket — the autotune
+    # guard that keeps a BENCH_r05-style 0.97x regression from shipping
+    # as the default (resolve_attention_impl). jnp elsewhere.
+    # 'jnp'/'pallas' force one path ('pallas' still falls back on
+    # unsupported buckets).
     attention_impl: str = "auto"
     # Edge-block grid sizes of the Pallas kernel (forward / backward);
     # None = the kernel's built-in per-bucket heuristic. Real tunable
@@ -257,34 +260,31 @@ def _dispatch_attention(cfg: "GTConfig", q, kk, v, proj_e, nbr_idx, edge_mask,
     """Pick the attention implementation: Pallas fused kernel on TPU for
     reference-exact scatter mode on supported buckets, jnp otherwise.
 
-    ``auto`` routing is evidence-driven (VERDICT r4 item 7): the fused
-    kernel wins the inference forward outright (1.18-2.06x across r4/r5
-    runs at p128) and is never slower inside the train step — the
-    decision-grade scanned A/B (tools/scan_ab.py, r5) measures train-scan
-    1.016x at b8 float32 (neutral) and 1.14x at b8 bfloat16, where the
-    faster decoder leaves attention a larger share — so auto uses Pallas
-    wherever the kernel supports the (batch, bucket) shape on the Mosaic
-    TPU backend. Force with attention_impl='pallas'/'jnp' (the bench's
-    A/B does exactly that). ``train`` is accepted for signature stability
+    ``auto`` routing is evidence-driven (VERDICT r4 item 7) and, since
+    gen-2, autotune-GUARDED: the decision lives in
+    ``ops.pallas_attention.resolve_attention_impl`` — TPU backend +
+    :func:`~deepinteract_tpu.ops.pallas_attention.supports` (now
+    dtype-aware: the live q.dtype threads through so bf16 buckets get
+    the halved working-set legality) + the measured A/B evidence store
+    (``DI_ATTENTION_AB``, written by ``tools/scan_ab.py`` and bench's
+    inline A/B). A bucket where the kernel measurably LOSES vs jnp
+    (BENCH_r05: 0.97x forward at b1 p128) routes to jnp with the reason
+    logged — the kernel can win its way back only through fresh
+    evidence. Force with attention_impl='pallas'/'jnp' (the bench's A/B
+    does exactly that). ``train`` is accepted for signature stability
     (routing no longer depends on it)."""
-    del train  # routing is shape/backend-driven only (see docstring)
+    del train  # routing is shape/backend/evidence-driven (see docstring)
+    import jax
+
+    from deepinteract_tpu.ops.pallas_attention import resolve_attention_impl
+
     n = q.shape[1]
-    use_pallas = False
-    if cfg.attention_mode == "scatter" and cfg.attention_impl in ("auto", "pallas"):
-        from deepinteract_tpu.ops.pallas_attention import supports
-
-        if supports(n, batch=q.shape[0], knn=nbr_idx.shape[-1],
-                    hidden=q.shape[-2] * q.shape[-1],
-                    num_heads=q.shape[-2]):
-            if cfg.attention_impl == "pallas":
-                use_pallas = True
-            else:  # auto: wherever the Mosaic TPU backend is present
-                import jax
-
-                use_pallas = jax.default_backend() == "tpu"
-    if use_pallas:
-        import jax
-
+    impl, _reason = resolve_attention_impl(
+        cfg.attention_mode, cfg.attention_impl, n,
+        batch=q.shape[0], knn=nbr_idx.shape[-1],
+        hidden=q.shape[-2] * q.shape[-1], num_heads=q.shape[-2],
+        dtype=q.dtype, backend=jax.default_backend())
+    if impl == "pallas":
         from deepinteract_tpu.ops.pallas_attention import edge_attention_pallas
 
         # Off-TPU (forced 'pallas', e.g. CPU tests) runs the interpreter.

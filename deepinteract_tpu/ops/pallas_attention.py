@@ -15,29 +15,69 @@ block-diagonal 0/1 matrices, so the entire op — score, gate, clip, exp,
 normalize, aggregate — runs in one kernel launch with everything resident
 in VMEM.
 
+Generation 2 (this revision) — dtype and grid changes driven by the PR-5
+end-to-end bf16 policy and the PR-7 attribution data:
+
+* **Policy-dtype inputs, bf16 MXU gathers.** q/k/v/proj_e enter the
+  kernel in the caller's compute dtype (bf16 under ``--compute_dtype
+  bfloat16``) instead of being upcast to f32 at the launch boundary. The
+  one-hot gather selectors are built in the same dtype (0/1 is exact in
+  bf16), so the three big ``onehot @ {q,k,v}`` contractions run as native
+  bf16 MXU matmuls with ``preferred_element_type=f32`` accumulation —
+  FlashAttention's discipline (arXiv:2205.14135): low-precision operands
+  on the MXU, f32 softmax/accumulator state. One-hot gathers sum exactly
+  one term per output element, so the f32-accumulated gather of bf16
+  inputs is EXACT — no numerics change beyond the input rounding the
+  policy already applied.
+* **Policy-dtype edge outputs.** ``e_out`` — the [B, N, K, H, D] gated
+  score tensor, the kernel's largest store — and the backward's ``dpe``
+  are written in the input dtype (the caller casts to the compute dtype
+  anyway, ``models/geometric_transformer.py``), halving their HBM
+  traffic under bf16. ``h_out``/``z_out`` stay f32: ``h_ref`` is the
+  cross-edge-block numerator ACCUMULATOR (revisited output block), and
+  accumulating in bf16 would lose the f32 softmax discipline.
+* **Dtype-aware legality, b16 bf16 unlocked.** ``supports`` scales both
+  VMEM gates by the policy dtype's itemsize: the measured whole-batch
+  edge-stream bound (gen-1 compiles kept the streamed [B, N*K, H]
+  tensors resident across the batch grid dim despite the batch-size-1
+  blocks — b16 p128 f32 failed AOT at 20.17 MB) and a new PER-BLOCK
+  estimate (:func:`kernel_vmem_estimate`) that sizes the long-context
+  grids. Under the bf16 policy the edge streams halve, so b16 p128
+  bf16 (10.5 MB — the same bytes as the measured-working b8 f32 point)
+  is now accepted while the measured b16 f32 failure stays rejected.
+  Misestimates cannot ship silently: the autotuner records failed trial
+  compiles per config, and auto-routing consults the measured A/B
+  evidence (:func:`resolve_attention_impl`).
+* **Long-context legality.** ``MAX_KERNEL_NODES`` is 512 (2x the
+  reference's 256-residue cap), with finer default edge-block grids past
+  n=256 so the [EB, N] selectors stay small; p384/p512 buckets (and
+  ``models/tiled.py``'s 512-pad tiles' encoder legs) dispatch through the
+  kernel instead of the jnp fallback.
+
 Numerics vs ``edge_attention(..., mode='scatter')``: bit-compatible for the
-single-block formulation (n <= 128, same clip/eps constants and float
-accumulation order); for the blocked path (n > 128) each destination
+single-block float32 formulation (n <= 128, same clip/eps constants and
+float accumulation order); for the blocked path (n > 128) each destination
 node's softmax numerator/denominator sums are split across edge blocks,
 which changes float accumulation order — parity there is tolerance-level
-(~1e-5, see tests/test_pallas_attention.py), not bitwise.
+(~1e-5, see tests/test_pallas_attention.py), not bitwise. Under bf16 the
+kernel computes per-edge scores in f32 from exact bf16 inputs where the
+jnp path computes them in bf16 — the kernel is the more precise of the
+two; parity is at bf16 tolerance.
 
-Scope: an edge-block grid keeps every working set in VMEM at any bucket up
-to ``MAX_KERNEL_NODES`` (the full reference regime — 256 residues,
-deepinteract_constants.py:10-12). Buckets <= 128 nodes run as one block
-(whole graph resident); larger buckets split the edge list into
-``n // 64`` blocks, accumulate the per-node numerator in the (revisited)
-output block and the softmax denominator in VMEM scratch, and normalize in
-the final grid step. Backward is a fused Pallas kernel in the same
-edge-block grid (``_bwd_kernel``): it recomputes the per-edge forward
-quantities from the saved inputs plus the forward's denominator output,
-then forms every gradient scatter as the transposed one-hot matmul —
-gradient parity vs the jnp path's VJP is tested at 1e-5.
+Backward is a fused Pallas kernel in the same edge-block grid
+(``_bwd_kernel``): it recomputes the per-edge forward quantities from the
+saved inputs plus the forward's denominator output, then forms every
+gradient scatter as the transposed one-hot matmul — gradient parity vs
+the jnp path's VJP is tested at 1e-5 (f32).
 """
 
 from __future__ import annotations
 
 import functools
+import json
+import logging
+import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -46,18 +86,35 @@ from jax.experimental.pallas import tpu as pltpu
 
 from deepinteract_tpu.ops.attention import CLIP, EPS, edge_attention
 
-# Largest supported padded bucket (= the reference's RESIDUE_COUNT_LIMIT).
-# Per-block VMEM at N=256, K=20, HD=128 with n//64 = 4 edge blocks:
-# two [1280, 256] one-hot selectors (~1.3 MB each), [1280, 128] edge tiles
-# (~0.65 MB each) and two [256, 128] accumulators — comfortably inside a
-# v5e core's ~16 MB VMEM (the whole-graph formulation needs ~26 MB there).
-MAX_KERNEL_NODES = 256
+logger = logging.getLogger(__name__)
+
+# Largest supported padded bucket — 2x the reference's RESIDUE_COUNT_LIMIT,
+# covering the long-context tier (p384/p512 buckets and models/tiled.py's
+# 512-pad tiles). Legality past 256 comes from the finer default edge-block
+# grids below (the [EB, N] one-hot selectors are the n-scaling term of the
+# per-block working set; see kernel_vmem_estimate).
+MAX_KERNEL_NODES = 512
+
+# Per-block VMEM budget for the legality estimate: a 16 MB core minus
+# headroom for Mosaic's block pipelining and fused temporaries the
+# estimate does not itemize. Calibrated so the known-good gen-1 points
+# (p128 f32 fwd+bwd at any batch, p256 with the default grids) pass and
+# oversized single-block overrides fail. A config that passes here can
+# still fail a real AOT compile, which the autotuner records as a failed
+# trial rather than adopting.
+VMEM_BUDGET_BYTES = 14 * 1024 * 1024
 
 
 def _num_edge_blocks(n: int, override=None) -> int:
     if override is not None:
         return int(override)
-    return 1 if n <= 128 else n // 64
+    if n <= 128:
+        return 1
+    if n <= 256:
+        return n // 64
+    # Long-context tier: halve the edge block again — the [EB, N]
+    # selector is EB*N*itemsize and N itself doubled.
+    return n // 32
 
 
 def _num_edge_blocks_bwd(n: int, override=None) -> int:
@@ -65,8 +122,12 @@ def _num_edge_blocks_bwd(n: int, override=None) -> int:
         return int(override)
     # The backward kernel holds ~2x the per-edge working set of forward
     # (both gradient and recomputed-forward tiles), so it halves the edge
-    # block relative to forward to stay comfortably inside VMEM at n=256.
-    return 1 if n <= 128 else n // 32
+    # block relative to forward at every tier.
+    if n <= 128:
+        return 1
+    if n <= 256:
+        return n // 32
+    return n // 16
 
 
 def edge_block_options(n: int, knn: int = 20, backward: bool = False,
@@ -83,7 +144,7 @@ def edge_block_options(n: int, knn: int = 20, backward: bool = False,
     e = n * knn
     default = _num_edge_blocks_bwd(n) if backward else _num_edge_blocks(n)
     opts = {default} if e % default == 0 else set()
-    for nb in (1, 2, 3, 4, 6, 8, 12, 16, 24, 32):
+    for nb in (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 40, 64):
         if e % nb:
             continue
         eb = e // nb
@@ -102,6 +163,283 @@ def _check_blocks(n: int, knn: int, nb: int, tag: str) -> None:
             f"counts: {edge_block_options(n, knn)}")
 
 
+def _itemsize(dtype) -> int:
+    """Bytes per element of a compute dtype ('bfloat16'/'float32' strings
+    or jnp dtypes); unknown dtypes count as 4 (conservative)."""
+    if dtype is None:
+        return 4
+    try:
+        return int(jnp.dtype(dtype).itemsize)
+    except TypeError:
+        return 4
+
+
+def _canonical_dtype(dtype):
+    """The in-kernel operand dtype for a caller dtype: bf16 stays bf16,
+    everything else (f32, f64, ints from sloppy callers) runs f32."""
+    if dtype is not None and jnp.dtype(dtype) == jnp.bfloat16:
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def kernel_vmem_estimate(n: int, knn: int = 20, hidden: int = 128,
+                         itemsize: int = 4, num_blocks=None,
+                         backward: bool = False) -> int:
+    """Estimated per-grid-step VMEM bytes of the gen-2 kernel.
+
+    Batch-independent by construction — every BlockSpec carries a
+    batch-size-1 block, so the grid's batch axis changes the step count,
+    not the resident set. Itemized streams (lane dim pads to 128):
+
+    * edge tiles: proj_e in + e_out out ([EB, HD] in the input dtype)
+      plus ~2 fused f32 per-edge temporaries (scores/weights);
+    * one-hot selectors: dst + src [EB, N], one copy in the input dtype
+      (MXU gathers) and one in f32 (scatter contractions);
+    * node tensors: q/k/v in the input dtype + h/z/scratch accumulators
+      in f32.
+
+    The backward holds roughly the forward set plus the gradient tiles —
+    modeled as 2x the edge-stream term (which is why its default block
+    count is twice the forward's).
+
+    This is a LEGALITY estimate, not a measurement: it exists to reject
+    configurations that are certain not to fit, while the autotuner's
+    per-config trial compiles (and the measured A/B evidence consulted by
+    :func:`resolve_attention_impl`) gate what actually ships.
+    """
+    nb = (_num_edge_blocks_bwd if backward else _num_edge_blocks)(
+        n, num_blocks)
+    e = n * knn
+    if e % nb:
+        return 1 << 62  # illegal grid: never fits by definition
+    eb = e // nb
+    lanes = max(hidden, 128)
+    npad = max(n, 128)
+    edge_streams = eb * lanes * (2 * itemsize + 2 * 4)
+    if backward:
+        # The gradient tiles (de in, dpe out) join the recomputed forward
+        # set, but Mosaic retires the forward temporaries as the gradient
+        # chain consumes them — ~1.5x forward, not 2x (gen-1's bwd ran
+        # the same n<=128 single-block grid as forward within budget).
+        edge_streams = (edge_streams * 3) // 2
+    onehots = 2 * eb * npad * (itemsize + 4)
+    nodes = npad * lanes * (3 * itemsize + 3 * 4)
+    return edge_streams + onehots + nodes
+
+
+# Empirical whole-batch edge-stream bound: Mosaic was MEASURED (gen-1,
+# on the same batch-tiled grid this kernel still uses) keeping the
+# streamed [B, N*K, H] edge tensors resident across the batch grid dim —
+# b16 p128 f32 allocated 20.17 MB and failed AOT compile with 'Ran out
+# of memory in memory space vmem' while b8 p128 f32 (~10.5 MB) compiled
+# and ran. The calibration point: the bound is the measured-working
+# ~10.5 MB plus headroom.
+BATCH_EDGE_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def supports(n: int, batch: int = 1, knn: int = 20, hidden: int = 128,
+             num_heads: int = 4, dtype=None) -> bool:
+    """Whether the kernel applies to this bucket: whole-graph up to 128
+    nodes, edge-block grid (requires the 64-multiple bucket sizes the
+    loader produces) up to ``MAX_KERNEL_NODES`` (2x the reference's
+    256-residue regime).
+
+    Two VMEM gates, both dtype-aware since gen-2:
+
+    * the MEASURED whole-batch edge-stream bound
+      (``BATCH_EDGE_BUDGET_BYTES``): despite the batch-tiled grid,
+      gen-1 compiles showed per-batch edge streams held resident across
+      the batch grid dim (b16 p128 f32 failed AOT at 20.17 MB; b8 fit
+      at ~10.5 MB). The bound now scales with the POLICY dtype's
+      itemsize, so the b16 p128 refusal lifts exactly for the bf16
+      policy (16*128*20*128*2 = 10.5 MB — the same bytes as the
+      measured-working b8 f32 point) while b16 f32 (21 MB, the measured
+      failure) stays rejected;
+    * the PER-BLOCK estimate (:func:`kernel_vmem_estimate`) for the
+      block-level working set the long-context grids are sized against.
+
+    The hidden/head floor excludes degenerate-tiling configs: lanes pad
+    the channel dim to 128, so tiny models inflate the stack instead of
+    shrinking it (measured on gen-1: hidden=8 / head_dim=4 at n=128
+    allocated 16.18 M and failed AOT compile — a smoke config, not a perf
+    target; such models route to the jnp path, where they are fast
+    anyway)."""
+    if hidden < 64 or hidden // max(num_heads, 1) < 16:
+        return False
+    item = _itemsize(_canonical_dtype(dtype))
+    if batch * n * knn * hidden * item > BATCH_EDGE_BUDGET_BYTES:
+        return False
+    if kernel_vmem_estimate(n, knn, hidden, item) > VMEM_BUDGET_BYTES:
+        return False
+    if kernel_vmem_estimate(n, knn, hidden, item,
+                            backward=True) > VMEM_BUDGET_BYTES:
+        return False
+    if n <= 128:
+        return True
+    return n <= MAX_KERNEL_NODES and n % 64 == 0
+
+
+def supports_config(gnn_cfg, n: int, batch: int = 1, knn: int = 20) -> bool:
+    """:func:`supports` with ``hidden``/``num_heads``/``compute_dtype``
+    taken from a real ``GTConfig`` instead of assumed defaults.
+
+    Call-site guard for code that holds a model config rather than runtime
+    tensor shapes (bench.py's A/B section; the serving engine's warmup
+    legality; the model itself threads the live shapes at
+    ``models/geometric_transformer.py``). A caller that passed only ``n``
+    would silently evaluate the head-dim floor against the flagship
+    defaults instead of the measured configuration (round-5 advisor
+    finding) — and, since gen-2, the dtype-aware VMEM estimate against
+    f32 instead of the configured policy dtype."""
+    return supports(n, batch=batch, knn=knn,
+                    hidden=gnn_cfg.hidden, num_heads=gnn_cfg.num_heads,
+                    dtype=getattr(gnn_cfg, "compute_dtype", None))
+
+
+# ---------------------------------------------------------------------------
+# Measured-A/B routing evidence (autotune-guarded kernel adoption)
+# ---------------------------------------------------------------------------
+
+# Evidence file (attention_ab/v1): written by tools/scan_ab.py and bench's
+# inline A/B, consulted by auto routing so a bucket where the kernel
+# measurably LOSES (BENCH_r05: 0.97x forward at b1 p128) can never ship as
+# the default again. {"schema": "attention_ab/v1", "entries":
+#   {"b8_p128": {"bfloat16": {"train_scan_speedup": 1.14, ...}}}}
+ATTENTION_AB_ENV = "DI_ATTENTION_AB"
+AB_SCHEMA = "attention_ab/v1"
+# Speedups at or below this are a measured loss -> auto routes to jnp.
+AB_LOSS_THRESHOLD = 1.0
+
+_ab_lock = threading.Lock()
+_ab_cache: dict = {"path": None, "mtime": None, "data": None}
+_route_logged: set = set()
+
+
+def attention_ab_path() -> str:
+    return os.environ.get(ATTENTION_AB_ENV, "")
+
+
+def load_attention_ab(path: str = "") -> dict:
+    """The evidence entries mapping (empty when unset/unreadable — a
+    corrupt evidence file must degrade to 'no opinion', not crash the
+    model's forward)."""
+    path = path or attention_ab_path()
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        mtime = os.path.getmtime(path)
+        with _ab_lock:
+            if _ab_cache["path"] == path and _ab_cache["mtime"] == mtime:
+                return _ab_cache["data"]
+        with open(path) as fh:
+            blob = json.load(fh)
+        entries = blob.get("entries", {}) if isinstance(blob, dict) else {}
+        with _ab_lock:
+            _ab_cache.update(path=path, mtime=mtime, data=entries)
+        return entries
+    except (OSError, ValueError):
+        return {}
+
+
+def record_attention_ab(path: str, batch: int, pad: int, dtype: str,
+                        **speedups) -> None:
+    """Merge one bucket's measured Pallas-vs-jnp speedups into the
+    evidence file (atomic rewrite). ``speedups`` keys are e.g.
+    ``train_scan_speedup`` / ``forward_speedup`` — jnp_time / pallas_time,
+    so <= 1.0 means the kernel lost."""
+    entries: dict = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                entries = json.load(fh).get("entries", {})
+        except (OSError, ValueError):
+            entries = {}
+    bucket = f"b{int(batch)}_p{int(pad)}"
+    per_dtype = entries.setdefault(bucket, {}).setdefault(str(dtype), {})
+    per_dtype.update({k: float(v) for k, v in speedups.items()
+                      if v is not None})
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"schema": AB_SCHEMA, "entries": entries}, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+    with _ab_lock:
+        _ab_cache.update(path=None, mtime=None, data=None)
+
+
+def measured_loss_reason(n: int, batch: int, dtype) -> str:
+    """Non-empty reason string when the evidence store records the kernel
+    LOSING (speedup <= AB_LOSS_THRESHOLD) for this exact bucket+dtype;
+    '' = no adverse evidence (missing evidence is not a loss).
+
+    Key precedence mirrors the repo's measurement lore (BASELINE.md):
+    ``train_scan_speedup`` — the K-step scanned dispatch — is the
+    decision-grade figure and, when present, decides ALONE; the
+    single-dispatch forward/train ratios carry ±10-20% tunnel spread and
+    are consulted only when no scanned evidence exists (so one noisy
+    single-dispatch rep cannot demote a bucket whose scanned A/B shows a
+    real win)."""
+    entries = load_attention_ab()
+    if not entries:
+        return ""
+    per_dtype = entries.get(f"b{int(batch)}_p{int(n)}", {})
+    ev = per_dtype.get(str(jnp.dtype(_canonical_dtype(dtype)).name), {})
+    speedups = {k: v for k, v in ev.items()
+                if k.endswith("speedup") and isinstance(v, (int, float))}
+    if not speedups:
+        return ""
+    if "train_scan_speedup" in speedups:
+        judged = {"train_scan_speedup": speedups["train_scan_speedup"]}
+    else:
+        judged = speedups
+    worst_key = min(judged, key=judged.get)
+    if judged[worst_key] <= AB_LOSS_THRESHOLD:
+        return (f"measured A/B shows pallas {judged[worst_key]:.3f}x "
+                f"({worst_key}) <= {AB_LOSS_THRESHOLD}x vs jnp for "
+                f"b{batch}_p{n}")
+    return ""
+
+
+def resolve_attention_impl(attention_mode: str, attention_impl: str,
+                           n: int, batch: int = 1, knn: int = 20,
+                           hidden: int = 128, num_heads: int = 4,
+                           dtype=None, backend: str = "") -> tuple:
+    """The routing decision ``(impl, reason)`` with impl in
+    {'pallas', 'jnp'} — the pure function behind ``_dispatch_attention``
+    (``models/geometric_transformer.py``), split out so the policy is
+    testable off-TPU.
+
+    ``auto`` uses the kernel wherever (a) the Mosaic TPU backend is
+    present, (b) :func:`supports` accepts the shape/dtype, and (c) the
+    measured A/B evidence store (``DI_ATTENTION_AB``) does not record the
+    kernel LOSING for the bucket — the autotune guard that makes the
+    BENCH_r05 0.97x-forward default unshippable. 'pallas' forces the
+    kernel on supported shapes regardless of evidence (the bench A/B
+    itself needs that); 'jnp' forces the reference path."""
+    if attention_mode != "scatter" or attention_impl == "jnp":
+        return "jnp", "jnp forced (impl or non-scatter mode)"
+    if not supports(n, batch=batch, knn=knn, hidden=hidden,
+                    num_heads=num_heads, dtype=dtype):
+        return "jnp", f"kernel does not support shape n={n} (see supports())"
+    if attention_impl == "pallas":
+        return "pallas", "pallas forced"
+    if backend != "tpu":
+        return "jnp", "auto: non-TPU backend"
+    reason = measured_loss_reason(n, batch, dtype)
+    if reason:
+        key = (n, batch, str(dtype))
+        if key not in _route_logged:
+            _route_logged.add(key)
+            logger.info("attention auto-routing picks jnp: %s", reason)
+        return "jnp", reason
+    return "pallas", "auto: supported bucket, no adverse A/B evidence"
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
 def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
             z_ref, z_acc, *, num_nodes: int, knn: int, num_heads: int,
             head_dim: int, num_eblocks: int):
@@ -113,22 +451,33 @@ def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
 
     nbr = nbr_ref[0]          # [EB, 1] int32
     mask = mask_ref[0]        # [EB, 1] f32
-    q = q_ref[0]              # [N, HD]
+    q = q_ref[0]              # [N, HD] in the input (policy) dtype
     k = k_ref[0]
     v = v_ref[0]
-    pe = pe_ref[0]            # [EB, HD]
+    pe = pe_ref[0]            # [EB, HD] in the input dtype
+    in_dtype = q.dtype
 
     node_ids = jax.lax.broadcasted_iota(jnp.int32, (eb, n), 1)
-    onehot_dst = (nbr == node_ids).astype(f32)                      # [EB, N]
+    onehot_dst_b = (nbr == node_ids)                                # [EB, N]
     src_ids = (jax.lax.broadcasted_iota(jnp.int32, (eb, 1), 0) + j * eb) // kk
-    onehot_src = (src_ids == node_ids).astype(f32)                  # [EB, N]
+    onehot_src_b = (src_ids == node_ids)                            # [EB, N]
+    # Gather selectors in the input dtype (bf16 MXU matmuls against the
+    # bf16 inputs; 0/1 is exact in bf16) ...
+    onehot_dst = onehot_dst_b.astype(in_dtype)
+    onehot_src = onehot_src_b.astype(in_dtype)
+    # ... scatter selectors in f32: the scatter contracts against f32
+    # softmax-weighted values (accumulation discipline, ops/attention.py).
+    onehot_dst_f = onehot_dst_b.astype(f32)
 
-    # Per-head sum / broadcast as block-diagonal 0/1 matmuls.
+    # Per-head sum / broadcast as block-diagonal 0/1 matmuls (f32: they
+    # contract the f32 score/weight tensors).
     lane_head = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
     head_ids = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 1)
     sum_mat = (lane_head == head_ids).astype(f32)                   # [HD, H]
 
     dot = functools.partial(jnp.dot, preferred_element_type=f32)
+    # One-hot gathers: exactly one nonzero per row, so the f32-accumulated
+    # result of bf16 operands is EXACT (no summation error to accumulate).
     q_dst = dot(onehot_dst, q)                                      # [EB, HD]
     k_src = dot(onehot_src, k)
     v_src = dot(onehot_src, v)
@@ -140,13 +489,16 @@ def _kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, e_ref,
 
     w_full = dot(w, sum_mat.T)                                       # [EB, HD]
     x = w_full * v_src
-    wv = jax.lax.dot_general(onehot_dst, x, (((0,), (0,)), ((), ())),
+    wv = jax.lax.dot_general(onehot_dst_f, x, (((0,), (0,)), ((), ())),
                              preferred_element_type=f32)             # [N, HD]
-    z = jax.lax.dot_general(onehot_dst, w, (((0,), (0,)), ((), ())),
+    z = jax.lax.dot_general(onehot_dst_f, w, (((0,), (0,)), ((), ())),
                             preferred_element_type=f32)              # [N, H]
     z_full = dot(z, sum_mat.T)                                       # [N, HD]
 
-    e_ref[0] = scaled * mask
+    # The edge output is stored in the input dtype (the caller casts to
+    # the compute dtype anyway) — the kernel's largest store, halved
+    # under bf16. A no-op cast under f32 keeps gen-1 bit-compatibility.
+    e_ref[0] = (scaled * mask).astype(e_ref.dtype)
 
     # Numerator accumulates in the revisited output block, denominator in
     # scratch; both zeroed on the first edge block, normalized on the last.
@@ -173,9 +525,9 @@ def _bwd_kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, z_ref,
     Per block: recompute the per-edge forward quantities (scores, clips,
     softmax weights) from the saved inputs plus the forward's denominator
     ``z`` and normalized output ``h``, then form every gradient scatter as
-    the transposed one-hot matmul. dq/dk/dv accumulate in revisited
+    the transposed one-hot matmul. dq/dk/dv accumulate in revisited f32
     [N, HD] output blocks across edge blocks (TPU grids iterate the last
-    dim sequentially); dpe is per-edge-block.
+    dim sequentially); dpe is per-edge-block, stored in the input dtype.
 
     Gradient math (e = edge, n = dst, s = src, heads h, dims d):
       num_nd = sum_e w_eh v_sd,  Z_nh = sum_e w_eh,  h = num / (Z + eps)
@@ -195,19 +547,24 @@ def _bwd_kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, z_ref,
 
     nbr = nbr_ref[0]
     mask = mask_ref[0]
-    q = q_ref[0]
+    q = q_ref[0]              # input (policy) dtype
     k = k_ref[0]
     v = v_ref[0]
     pe = pe_ref[0]
-    h_saved = h_ref[0]
+    h_saved = h_ref[0]        # f32 residuals
     zf = z_ref[0]
-    dh = dh_ref[0]
-    de = de_ref[0]
+    dh = dh_ref[0]            # f32 cotangent (h_out is f32)
+    de = de_ref[0]            # input-dtype cotangent (e_out dtype)
+    in_dtype = q.dtype
 
     node_ids = jax.lax.broadcasted_iota(jnp.int32, (eb, n), 1)
-    onehot_dst = (nbr == node_ids).astype(f32)
+    onehot_dst_b = (nbr == node_ids)
     src_ids = (jax.lax.broadcasted_iota(jnp.int32, (eb, 1), 0) + j * eb) // kk
-    onehot_src = (src_ids == node_ids).astype(f32)
+    onehot_src_b = (src_ids == node_ids)
+    onehot_dst = onehot_dst_b.astype(in_dtype)   # bf16 MXU gathers
+    onehot_src = onehot_src_b.astype(in_dtype)
+    onehot_dst_f = onehot_dst_b.astype(f32)      # f32 scatters
+    onehot_src_f = onehot_src_b.astype(f32)
 
     lane_head = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
     head_ids = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 1)
@@ -215,11 +572,12 @@ def _bwd_kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, z_ref,
 
     dot = functools.partial(jnp.dot, preferred_element_type=f32)
 
-    def scatter(onehot, x):  # [EB, N]^T @ [EB, X] -> [N, X]
+    def scatter(onehot, x):  # [EB, N]^T @ [EB, X] -> [N, X], f32
         return jax.lax.dot_general(onehot, x, (((0,), (0,)), ((), ())),
                                    preferred_element_type=f32)
 
-    # Recomputed forward per-edge quantities.
+    # Recomputed forward per-edge quantities (gathers of the policy-dtype
+    # inputs are exact in f32 accumulation — see _kernel).
     q_dst = dot(onehot_dst, q)
     k_src = dot(onehot_src, k)
     v_src = dot(onehot_src, v)
@@ -236,13 +594,13 @@ def _bwd_kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, z_ref,
     dnum = dh * invz
     dz_h = -dot(h_saved * dnum, sum_mat)                         # [N, H]
 
-    dnum_dst = dot(onehot_dst, dnum)                             # [EB, HD]
-    dz_dst = dot(onehot_dst, dz_h)                               # [EB, H]
+    dnum_dst = dot(onehot_dst_f, dnum)                           # [EB, HD]
+    dz_dst = dot(onehot_dst_f, dz_h)                             # [EB, H]
     dw = dot(dnum_dst * v_src, sum_mat) + dz_dst                 # [EB, H]
     dl = dw * w
     dsum = jnp.where((sum_pre > -CLIP) & (sum_pre < CLIP), dl, 0.0)
     ds = dot(dsum, sum_mat.T) + de * mask                        # [EB, HD]
-    dpe_ref[0] = ds * c
+    dpe_ref[0] = (ds * c).astype(dpe_ref.dtype)
     dc = ds * pe
     da = jnp.where((a > -CLIP) & (a < CLIP), dc, 0.0) * inv_sqrt_d
 
@@ -252,9 +610,9 @@ def _bwd_kernel(nbr_ref, mask_ref, q_ref, k_ref, v_ref, pe_ref, h_ref, z_ref,
         dk_ref[0] = jnp.zeros((n, hd), f32)
         dv_ref[0] = jnp.zeros((n, hd), f32)
 
-    dq_ref[0] += scatter(onehot_dst, da * k_src)
-    dk_ref[0] += scatter(onehot_src, da * q_dst)
-    dv_ref[0] += scatter(onehot_src, w_full * dnum_dst)
+    dq_ref[0] += scatter(onehot_dst_f, da * k_src)
+    dk_ref[0] += scatter(onehot_src_f, da * q_dst)
+    dv_ref[0] += scatter(onehot_src_f, w_full * dnum_dst)
 
 
 def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False,
@@ -266,6 +624,7 @@ def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False,
     nb = _num_edge_blocks(n, num_blocks)
     _check_blocks(n, kk, nb, "forward")
     eb = e // nb
+    in_dtype = _canonical_dtype(q.dtype)
 
     kernel = functools.partial(
         _kernel, num_nodes=n, knn=kk, num_heads=h, head_dim=d, num_eblocks=nb
@@ -289,7 +648,7 @@ def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, n, hd), jnp.float32),
-            jax.ShapeDtypeStruct((b, e, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, e, hd), in_dtype),
             jax.ShapeDtypeStruct((b, n, hd), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((n, hd), jnp.float32)],
@@ -297,10 +656,10 @@ def _pallas_forward(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False,
     )(
         nbr_idx.reshape(b, e, 1).astype(jnp.int32),
         edge_mask.reshape(b, e, 1).astype(jnp.float32),
-        flat(q).astype(jnp.float32),
-        flat(k).astype(jnp.float32),
-        flat(v).astype(jnp.float32),
-        flat(proj_e).astype(jnp.float32),
+        flat(q).astype(in_dtype),
+        flat(k).astype(in_dtype),
+        flat(v).astype(in_dtype),
+        flat(proj_e).astype(in_dtype),
     )
     return h_out.reshape(b, n, h, d), e_out.reshape(b, n, kk, h, d), z_out
 
@@ -314,6 +673,7 @@ def _pallas_backward(q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out,
     nb = _num_edge_blocks_bwd(n, num_blocks)
     _check_blocks(n, kk, nb, "backward")
     eb = e // nb
+    in_dtype = _canonical_dtype(q.dtype)
 
     kernel = functools.partial(
         _bwd_kernel, num_nodes=n, knn=kk, num_heads=h, head_dim=d,
@@ -336,20 +696,20 @@ def _pallas_backward(q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out,
             jax.ShapeDtypeStruct((b, n, hd), jnp.float32),
             jax.ShapeDtypeStruct((b, n, hd), jnp.float32),
             jax.ShapeDtypeStruct((b, n, hd), jnp.float32),
-            jax.ShapeDtypeStruct((b, e, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, e, hd), in_dtype),
         ],
         interpret=interpret,
     )(
         nbr_idx.reshape(b, e, 1).astype(jnp.int32),
         edge_mask.reshape(b, e, 1).astype(jnp.float32),
-        flat(q).astype(jnp.float32),
-        flat(k).astype(jnp.float32),
-        flat(v).astype(jnp.float32),
-        flat(proj_e).astype(jnp.float32),
+        flat(q).astype(in_dtype),
+        flat(k).astype(in_dtype),
+        flat(v).astype(in_dtype),
+        flat(proj_e).astype(in_dtype),
         flat(h_out).astype(jnp.float32),
         z_out.astype(jnp.float32),
         flat(dh).astype(jnp.float32),
-        flat(de).astype(jnp.float32),
+        flat(de).astype(in_dtype),
     )
     return (dq.reshape(b, n, h, d), dk.reshape(b, n, h, d),
             dv.reshape(b, n, h, d), dpe.reshape(b, n, kk, h, d))
@@ -359,7 +719,8 @@ def _pallas_backward(q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out,
 def edge_attention_pallas(q, k, v, proj_e, nbr_idx, edge_mask,
                           interpret=False, fwd_blocks=None, bwd_blocks=None):
     """Drop-in replacement for ``edge_attention(..., mode='scatter')`` on
-    TPU for buckets with N <= MAX_KERNEL_NODES. Returns (h_out, e_out).
+    TPU for buckets with N <= MAX_KERNEL_NODES. Returns (h_out, e_out) —
+    h_out in f32 (the softmax accumulator), e_out in the input dtype.
 
     ``fwd_blocks``/``bwd_blocks`` override the edge-block grid sizes
     (None = the built-in per-bucket heuristic). These are the real
@@ -379,7 +740,8 @@ def _fwd(q, k, v, proj_e, nbr_idx, edge_mask, interpret=False,
                                           interpret, fwd_blocks)
     # h and z (the softmax denominator) ride along as residuals so the
     # backward kernel never re-runs the full forward — it recomputes only
-    # the per-edge quantities block-locally.
+    # the per-edge quantities block-locally. q/k/v/proj_e residuals stay
+    # in the policy dtype (half the residual bytes under bf16).
     return (h_out, e_out), (q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out)
 
 
@@ -390,51 +752,12 @@ def _bwd(interpret, fwd_blocks, bwd_blocks, res, grads):
         q, k, v, proj_e, nbr_idx, edge_mask, h_out, z_out, dh, de, interpret,
         bwd_blocks,
     )
-    # The kernel computes (and returns) float32; cotangents must match the
+    # dq/dk/dv accumulate in float32 in-kernel; cotangents must match the
     # primals' dtypes — under a bf16 compute policy q/k/v/proj_e arrive
-    # bf16 while the f32 accumulation above stays intact.
+    # bf16 while the f32 accumulation above stays intact (dpe is already
+    # written in the input dtype by the kernel).
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             dpe.astype(proj_e.dtype), None, None)
 
 
 edge_attention_pallas.defvjp(_fwd, _bwd)
-
-
-def supports(n: int, batch: int = 1, knn: int = 20, hidden: int = 128,
-             num_heads: int = 4) -> bool:
-    """Whether the kernel applies to this bucket: whole-graph up to 128
-    nodes, edge-block grid (requires the 64-multiple bucket sizes the
-    loader produces) up to the reference's 256-residue regime.
-
-    The batch guard bounds the kernel's scoped-vmem stack: blocks carry
-    the whole batch dim, so the [B, N*K, H] edge tensor must fit the
-    ~16 MB vmem stack with headroom (measured: b16 p128 allocates
-    20.17 M and fails AOT compile with 'Ran out of memory in memory
-    space vmem'; b8 p128 at ~10.5 MB compiles and runs).
-
-    The hidden/head floor excludes degenerate-tiling configs: lanes pad
-    the channel dim to 128, so tiny models inflate the stack instead of
-    shrinking it (measured: hidden=8 / head_dim=4 at n=128 allocates
-    16.18 M and fails AOT compile — a smoke config, not a perf target;
-    such models route to the jnp path, where they are fast anyway)."""
-    if hidden < 64 or hidden // max(num_heads, 1) < 16:
-        return False
-    if batch * n * knn * hidden * 4 > 12 * 1024 * 1024:
-        return False
-    if n <= 128:
-        return True
-    return n <= MAX_KERNEL_NODES and n % 64 == 0
-
-
-def supports_config(gnn_cfg, n: int, batch: int = 1, knn: int = 20) -> bool:
-    """:func:`supports` with ``hidden``/``num_heads`` taken from a real
-    ``GTConfig`` instead of assumed defaults.
-
-    Call-site guard for code that holds a model config rather than runtime
-    tensor shapes (bench.py's A/B section; the model itself threads the
-    live shapes at ``models/geometric_transformer.py:252``). A caller that
-    passed only ``n`` would silently evaluate the head-dim floor against
-    the flagship defaults instead of the measured configuration (round-5
-    advisor finding)."""
-    return supports(n, batch=batch, knn=knn,
-                    hidden=gnn_cfg.hidden, num_heads=gnn_cfg.num_heads)

@@ -206,12 +206,37 @@ class InferenceEngine:
         adopted = consume.respect_explicit(
             adopted, stem=self.cfg.pin_interaction_stem,
             dtype=self.cfg.pin_compute_dtype)
+        warmup_pads = {p for spec in (self.cfg.warmup_buckets
+                                      or ((b1, b2, bs),))
+                       for p in spec[:2]}
         adopted, blocks_note = consume.restrict_pallas_blocks(
-            adopted,
-            {p for spec in (self.cfg.warmup_buckets or ((b1, b2, bs),))
-             for p in spec[:2]},
-            knn=constants.KNN)
+            adopted, warmup_pads, knn=constants.KNN)
         trial = adopted.config
+        if (trial.pallas_fwd_blocks is not None
+                or trial.pallas_bwd_blocks is not None):
+            # Gen-2 warmup legality: a tuned Pallas grid is only
+            # meaningful where the KERNEL itself is legal for every
+            # warmup bucket under the dtype policy this engine will
+            # actually compile with — supports_config threads
+            # hidden/num_heads/compute_dtype (dtype-aware since the
+            # gen-2 kernel; ops/pallas_attention.py).
+            from deepinteract_tpu.ops.pallas_attention import supports_config
+
+            gnn_probe = base.gnn
+            if trial.compute_dtype is not None:
+                gnn_probe = dataclasses.replace(
+                    gnn_probe, compute_dtype=trial.compute_dtype)
+            illegal = sorted(p for p in warmup_pads
+                             if not supports_config(gnn_probe, p, batch=bs))
+            if illegal:
+                adopted = dataclasses.replace(
+                    adopted, config=dataclasses.replace(
+                        trial, pallas_fwd_blocks=None,
+                        pallas_bwd_blocks=None))
+                trial = adopted.config
+                blocks_note += (
+                    " (tuned Pallas grid NOT applied: kernel unsupported "
+                    f"at warmup pad(s) {illegal} for this model/dtype)")
         gnn = dataclasses.replace(
             base.gnn,
             pallas_fwd_blocks=trial.pallas_fwd_blocks,

@@ -48,6 +48,20 @@ DEFAULT_WARMUP = 2
 DEFAULT_ITERS = 12
 DEFAULT_REPS = 3
 
+# Healthy band for the per-rep linearity ratio t_2k / t_k: ~2.0 means the
+# differenced subtraction is operating on an almost-pure per-call signal;
+# toward 1.0 the fixed overhead dominates and the subtraction amplifies
+# noise; above ~2.5 the run is super-linear (interference, thermal, or
+# caching effects). BENCH_r05 shipped headline numbers at linearity
+# 1.53-1.93 without comment — samples that unstable now carry an explicit
+# ``timing_warning`` so consumers (bench contract line,
+# tools/check_perf_regression.py) can widen their tolerance instead of
+# treating the figure as decision-grade.
+LINEARITY_HEALTHY_BAND = (1.55, 2.45)
+# Per-rep spread (max - min linearity across reps) beyond which the
+# samples disagree about the measurement regime itself.
+LINEARITY_SPREAD_LIMIT = 0.35
+
 
 def resolve_peak_flops(device_kind: str) -> float:
     if "DI_PEAK_FLOPS" in os.environ:
@@ -189,6 +203,9 @@ def time_compiled(fn, args, iters: int = DEFAULT_ITERS,
         samples.append(per_call)
         overheads.append(t1 - k * per_call)
         linearity.append(t2 / t1 if t1 > 0 else float("inf"))
+    finite_lin = [v for v in linearity if np.isfinite(v)]
+    spread = (float(max(finite_lin) - min(finite_lin))
+              if len(finite_lin) > 1 else 0.0)
     timing = {
         "median": float(np.median(samples)),
         "min": float(np.min(samples)),
@@ -197,12 +214,41 @@ def time_compiled(fn, args, iters: int = DEFAULT_ITERS,
         "calls_per_sample": k,
         "overhead_ms": float(np.median(overheads)) * 1e3,
         "linearity": float(np.median(linearity)),
+        "linearity_spread": spread,
         "clamped_samples": clamped,
         "protocol": "differenced+host-fetch",
     }
+    warning = timing_warning(timing)
+    if warning:
+        timing["timing_warning"] = warning
     if memory is not None:
         timing["memory"] = memory
     return compile_s, timing, flops
+
+
+def timing_warning(timing: Dict) -> str:
+    """Non-empty description when a differenced-timing dict looks
+    UNSTABLE — clamped reps, median linearity outside the healthy band,
+    or reps disagreeing with each other (the BENCH_r05 1.53-1.93 case).
+    Consumers: bench lifts this into the section detail and contract
+    line; tools/check_perf_regression.py widens its tolerance for keys
+    measured under a warning."""
+    lo, hi = LINEARITY_HEALTHY_BAND
+    problems = []
+    if timing.get("clamped_samples", 0) > 0:
+        problems.append(
+            f"{timing['clamped_samples']} clamped sample(s) (t_2k <= t_k)")
+    lin = timing.get("linearity")
+    if lin is not None and not lo <= lin <= hi:
+        problems.append(
+            f"median linearity {lin:.2f} outside healthy band "
+            f"[{lo}, {hi}] (ideal 2.0 — differenced signal degraded)")
+    spread = timing.get("linearity_spread")
+    if spread is not None and spread > LINEARITY_SPREAD_LIMIT:
+        problems.append(
+            f"linearity spread {spread:.2f} across reps > "
+            f"{LINEARITY_SPREAD_LIMIT} (reps disagree on the regime)")
+    return "; ".join(problems)
 
 
 def mfu_guard_violations(entry: Dict, keys, threshold: float = 1.02) -> Dict:

@@ -239,6 +239,76 @@ def test_scan_chunks_remat_matches(rng):
     assert all(np.all(np.isfinite(g)) for g in jax.tree_util.tree_leaves(grads))
 
 
+def test_biasconv_pad_value_is_bias_and_tree_compatible(rng):
+    """The r10 remask burn-down contract: a 1x1 conv fed zero pads emits
+    its bias at every padded pixel, so BiasConv1x1's closed-form pad
+    value (the bias parameter, no matvec) must equal the conv's actual
+    output on a zero pixel — and its param tree must stay byte-compatible
+    with nn.Conv (checkpoints interchangeable)."""
+    from flax import linen as nn
+
+    from deepinteract_tpu.models.decoder import BiasConv1x1
+
+    x = jnp.asarray(rng.normal(size=(2, 6, 5, 16)).astype(np.float32))
+    mask = np.zeros((2, 6, 5), bool)
+    mask[:, :4, :3] = True
+    xm = x * jnp.asarray(mask)[..., None]
+
+    conv = BiasConv1x1(8)
+    variables = conv.init(jax.random.PRNGKey(0), xm)
+    y, pv = conv.apply(variables, xm)
+    # Padded pixels of the output hold exactly the claimed pad value.
+    np.testing.assert_allclose(np.asarray(y)[~mask],
+                               np.broadcast_to(np.asarray(pv)[0, 0, 0],
+                                               np.asarray(y)[~mask].shape),
+                               rtol=1e-6, atol=1e-6)
+    # Param tree is nn.Conv(features, (1, 1))-shaped: same leaves, and the
+    # same params produce the same map through a real nn.Conv.
+    ref = nn.Conv(8, (1, 1))
+    ref_vars = ref.init(jax.random.PRNGKey(0), xm)
+    assert (jax.tree_util.tree_map(jnp.shape, variables["params"])
+            == jax.tree_util.tree_map(jnp.shape, ref_vars["params"]))
+    y_ref = ref.apply(variables, xm)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_depad_path_has_no_pad_value_matvecs(rng):
+    """The ISSUE-10 census reconciliation, pinned structurally: the r5
+    fast path pushed a [B,1,1,C] pad value through every 1x1 conv as a
+    tiny contraction (112 launches per flagship forward — the top
+    re-mask-class sink in the PR-7 attribution). The r10 path tracks pad
+    values in closed form only, so the ONLY dot/contraction ops left in
+    the compiled depad decoder are the SE-block denses — identical in
+    count to the depad_stats=False decoder, whose pv machinery never
+    existed."""
+    import collections
+
+    from deepinteract_tpu.obs import hloquery
+
+    def whole_module_dots(cfg):
+        x = jnp.asarray(rng.normal(size=(1, 16, 16, 16)).astype(np.float32))
+        mask_np = np.zeros((1, 16, 16), bool)
+        mask_np[:, :12, :11] = True
+        mask = jnp.asarray(mask_np)
+        model = InteractionDecoder(cfg)
+        variables = model.init(jax.random.PRNGKey(0), x, mask)
+        compiled = jax.jit(
+            lambda v, xx: model.apply(v, xx, mask)).lower(variables, x).compile()
+        total = collections.Counter()
+        for census in hloquery.computation_census(
+                compiled.as_text()).values():
+            total.update(census)
+        return total.get("dot", 0) + total.get("convolution", 0)
+
+    import dataclasses
+
+    cfg_fast = small_cfg(num_chunks=2, dilation_cycle=(1, 2),
+                         depad_stats=True, scan_chunks=False)
+    cfg_ref = dataclasses.replace(cfg_fast, depad_stats=False)
+    assert whole_module_dots(cfg_fast) <= whole_module_dots(cfg_ref)
+
+
 def test_depad_stats_matches_masked_path(rng):
     """The de-padded statistics fast path must agree with the plain masked
     formulation on identical params (same statistics, different algebra),

@@ -60,18 +60,25 @@ def test_gradient_parity(rng):
 
 def test_supports_guard():
     assert supports(64) and supports(128)
-    # Edge-block grid extends to the reference's 256-residue regime
-    # (deepinteract_constants.py:10-12); >128 needs the loader's
-    # 64-multiple buckets.
+    # Gen-2: the edge-block grid extends to MAX_KERNEL_NODES=512 — the
+    # long-context tier (and models/tiled.py's 512-pad tiles) dispatches
+    # through the kernel; >128 needs the loader's 64-multiple buckets.
     assert supports(192) and supports(256)
-    assert not supports(320)
+    assert supports(384) and supports(512)
+    assert not supports(576)
     assert not supports(200)
-    # Batch guard: blocks carry the batch dim, so the edge tensor must fit
-    # the ~16M vmem stack (b16 p128 fails AOT compile; b8 fits).
+    # Whole-batch edge-stream bound, dtype-aware since gen-2: the gen-1
+    # MEASURED failure points (b16 p128 f32 at 20.17 MB, b8 p256 f32)
+    # stay rejected, but the bound scales with the policy itemsize — so
+    # b16 p128 under the bf16 policy (10.5 MB, the same bytes as the
+    # measured-working b8 f32 point) is now ACCEPTED.
     assert supports(128, batch=8)
     assert not supports(128, batch=16)
-    assert supports(256, batch=4)
     assert not supports(256, batch=8)
+    assert supports(128, batch=16, dtype="bfloat16")
+    assert supports(256, batch=8, dtype="bfloat16")
+    assert supports(256, batch=4)
+    assert supports(512, dtype="bfloat16")
     # Tiny-model floor: hidden=8 / head_dim=4 measured a 16.18M vmem
     # stack AOT failure at n=128 (lane padding inflates small channels).
     assert not supports(128, hidden=8, num_heads=2)
@@ -80,10 +87,11 @@ def test_supports_guard():
 
 
 def test_supports_config_threads_real_model_shape():
-    """supports_config must evaluate the CONFIG's hidden/num_heads, not
-    the flagship defaults — a config the head-dim floor rejects must be
-    rejected even though supports(n) alone would pass (ISSUE-2 satellite:
-    bench.py's A/B guard used to pass only the pad)."""
+    """supports_config must evaluate the CONFIG's hidden/num_heads (and,
+    gen-2, its compute_dtype), not the flagship defaults — a config the
+    head-dim floor rejects must be rejected even though supports(n) alone
+    would pass (ISSUE-2 satellite: bench.py's A/B guard used to pass only
+    the pad)."""
     from deepinteract_tpu.models.geometric_transformer import GTConfig
     from deepinteract_tpu.models.model import ModelConfig
     from deepinteract_tpu.ops.pallas_attention import supports_config
@@ -96,8 +104,39 @@ def test_supports_config_threads_real_model_shape():
     assert supports(128) and not supports_config(tiny, 128)
     headdim_floor = GTConfig(hidden=64, num_heads=8)
     assert not supports_config(headdim_floor, 128)
-    # Batch/knn still thread through alongside the config.
+    # Gen-2 acceptance (ISSUE-10 satellite): b16 p128 is ACCEPTED under
+    # the bf16 policy — the config's compute_dtype threads into the
+    # dtype-aware edge-stream bound, halving the bytes to the
+    # measured-working level — while the f32 flavor (the gen-1 measured
+    # 20.17 MB AOT failure) stays rejected.
     assert not supports_config(flagship, 128, batch=16)
+    bf16 = GTConfig(compute_dtype="bfloat16")
+    assert supports_config(bf16, 128, batch=16)
+    assert supports_config(bf16, 512)
+    # knn still threads through alongside the config.
+    assert supports_config(flagship, 128, knn=20)
+
+
+def test_gen2_long_context_legality():
+    """edge_block_options must offer legal grids (defaults included) at
+    the long-context tier the gen-2 kernel unlocked (n=384/512), for both
+    directions, at the real knn=20."""
+    from deepinteract_tpu.ops.pallas_attention import (
+        _num_edge_blocks,
+        _num_edge_blocks_bwd,
+        edge_block_options,
+    )
+
+    for n in (384, 512):
+        for backward in (False, True):
+            opts = edge_block_options(n, 20, backward=backward)
+            assert opts, f"no legal grids at n={n} backward={backward}"
+            default = (_num_edge_blocks_bwd(n) if backward
+                       else _num_edge_blocks(n))
+            assert default in opts
+            e = n * 20
+            for nb in opts:
+                assert e % nb == 0
 
 
 def test_forward_parity_blocked_256(rng):
@@ -136,6 +175,189 @@ def test_gradient_parity_blocked_256(rng):
     g_ker = jax.grad(loss_ker, argnums=(0, 1, 2, 3))(q, k, v, pe)
     for a, b in zip(g_ker, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_forward_parity_blocked_384_and_512(rng):
+    """Gen-2 long-context grids (12 blocks at n=384, 16 at n=512) must
+    match the jnp scatter reference through the cross-block accumulation
+    and final-step normalization."""
+    for n in (384, 512):
+        q, k, v, pe, nbr, mask = _jnp_inputs(rng, b=1, n=n, k=4, h=2, d=8)
+        h_ref, e_ref = edge_attention(q, k, v, pe, nbr, mask, mode="scatter")
+        h_ker, e_ker = edge_attention_pallas(q, k, v, pe, nbr, mask, True)
+        np.testing.assert_allclose(np.asarray(h_ker), np.asarray(h_ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(e_ker), np.asarray(e_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_parity_blocked_512(rng):
+    """Fused backward at the gen-2 512-node tier (32 bwd edge blocks)."""
+    q, k, v, pe, nbr, mask = _jnp_inputs(rng, b=1, n=512, k=4, h=2, d=8)
+
+    def loss_ref(q_, k_, v_, pe_):
+        h, e = edge_attention(q_, k_, v_, pe_, nbr, mask, mode="scatter")
+        return (h ** 2).sum() + (e * 0.3).sum()
+
+    def loss_ker(q_, k_, v_, pe_):
+        h, e = edge_attention_pallas(q_, k_, v_, pe_, nbr, mask, True)
+        return (h ** 2).sum() + (e * 0.3).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, pe)
+    g_ker = jax.grad(loss_ker, argnums=(0, 1, 2, 3))(q, k, v, pe)
+    for a, b in zip(g_ker, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def _bf16(t):
+    return t.astype(jnp.bfloat16) if t.dtype == jnp.float32 else t
+
+
+def test_bf16_forward_parity(rng):
+    """Gen-2 policy-dtype path: bf16 inputs stay bf16 (the MXU-matmul
+    operands), softmax/accumulators stay f32. e_out comes back in the
+    input dtype, h_out in f32; parity vs the jnp bf16 path is at bf16
+    tolerance (the kernel computes per-edge scores in f32 from exact bf16
+    inputs — MORE precise than jnp's bf16 scores, not less)."""
+    for n, k in ((64, 8), (192, 4)):
+        q, kk, v, pe, nbr, mask = _jnp_inputs(rng, b=2, n=n, k=k, h=4, d=16)
+        qb, kb, vb, peb = map(_bf16, (q, kk, v, pe))
+        h_ref, e_ref = edge_attention(qb, kb, vb, peb, nbr, mask,
+                                      mode="scatter")
+        h_ker, e_ker = edge_attention_pallas(qb, kb, vb, peb, nbr, mask, True)
+        assert e_ker.dtype == jnp.bfloat16
+        assert h_ker.dtype == jnp.float32
+        np.testing.assert_allclose(
+            np.asarray(h_ker), np.asarray(h_ref, dtype=np.float32),
+            rtol=3e-2, atol=3e-2)
+        np.testing.assert_allclose(
+            np.asarray(e_ker, dtype=np.float32),
+            np.asarray(e_ref, dtype=np.float32), rtol=3e-2, atol=3e-2)
+
+
+def test_bf16_gradient_parity(rng):
+    """bf16 custom-vjp: cotangent dtypes match the primals (dq/dk/dv/dpe
+    come back bf16) and gradients agree with the jnp VJP at bf16
+    tolerance, padded+masked."""
+    q, kk, v, pe, nbr, mask = _jnp_inputs(rng, b=1, n=64, k=6, h=2, d=8)
+    qb, kb, vb, peb = map(_bf16, (q, kk, v, pe))
+
+    def loss_ref(q_, k_, v_, pe_):
+        h, e = edge_attention(q_, k_, v_, pe_, nbr, mask, mode="scatter")
+        return (h.astype(jnp.float32) ** 2).sum() + (
+            e.astype(jnp.float32) * 0.3).sum()
+
+    def loss_ker(q_, k_, v_, pe_):
+        h, e = edge_attention_pallas(q_, k_, v_, pe_, nbr, mask, True)
+        return (h.astype(jnp.float32) ** 2).sum() + (
+            e.astype(jnp.float32) * 0.3).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(qb, kb, vb, peb)
+    g_ker = jax.grad(loss_ker, argnums=(0, 1, 2, 3))(qb, kb, vb, peb)
+    for a, b in zip(g_ker, g_ref):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+            rtol=6e-2, atol=6e-2)
+
+
+def test_batch_tiled_b16_parity(rng):
+    """The batch-tiled grid at b16 p128 — the exact shape gen-1 refused on
+    vmem — must run (interpret mode exercises the same grid/BlockSpec
+    program Mosaic compiles) and match the jnp reference. bf16 flavor
+    too, since that is the flagship policy."""
+    q, k, v, pe, nbr, mask = _jnp_inputs(rng, b=16, n=128, k=4, h=2, d=8)
+    h_ref, e_ref = edge_attention(q, k, v, pe, nbr, mask, mode="scatter")
+    h_ker, e_ker = edge_attention_pallas(q, k, v, pe, nbr, mask, True)
+    np.testing.assert_allclose(np.asarray(h_ker), np.asarray(h_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(e_ker), np.asarray(e_ref),
+                               rtol=1e-5, atol=1e-5)
+    qb, kb, vb, peb = map(_bf16, (q, k, v, pe))
+    h_b, e_b = edge_attention_pallas(qb, kb, vb, peb, nbr, mask, True)
+    hr_b, _ = edge_attention(qb, kb, vb, peb, nbr, mask, mode="scatter")
+    np.testing.assert_allclose(
+        np.asarray(h_b), np.asarray(hr_b, dtype=np.float32),
+        rtol=3e-2, atol=3e-2)
+
+
+def test_resolve_attention_impl_evidence_guard(tmp_path, monkeypatch):
+    """Autotune-guarded adoption (ISSUE-10 satellite): auto routing must
+    pick jnp — with a reason — for a bucket whose recorded A/B shows the
+    kernel losing (<= 1.0x), keep pallas where evidence shows a win or is
+    absent, and let attention_impl='pallas' force past the evidence."""
+    from deepinteract_tpu.ops.pallas_attention import (
+        record_attention_ab,
+        resolve_attention_impl,
+    )
+
+    store = str(tmp_path / "attention_ab.json")
+    # The BENCH_r05 regression shape: forward loses at b1 p128 f32.
+    record_attention_ab(store, 1, 128, "float32",
+                        forward_speedup=0.97, train_speedup=1.03)
+    record_attention_ab(store, 8, 128, "bfloat16", train_scan_speedup=1.14)
+    monkeypatch.setenv("DI_ATTENTION_AB", store)
+
+    impl, reason = resolve_attention_impl(
+        "scatter", "auto", 128, batch=1, dtype=jnp.float32, backend="tpu")
+    assert impl == "jnp" and "0.97" in reason
+
+    impl, _ = resolve_attention_impl(
+        "scatter", "auto", 128, batch=8, dtype=jnp.bfloat16, backend="tpu")
+    assert impl == "pallas"
+    # No evidence for the bucket = no opinion: auto keeps the kernel.
+    impl, _ = resolve_attention_impl(
+        "scatter", "auto", 256, batch=1, dtype=jnp.float32, backend="tpu")
+    assert impl == "pallas"
+    # Forcing 'pallas' bypasses the evidence (the bench A/B needs that).
+    impl, reason = resolve_attention_impl(
+        "scatter", "pallas", 128, batch=1, dtype=jnp.float32, backend="tpu")
+    assert impl == "pallas" and "forced" in reason
+    # Off-TPU auto always routes jnp; unsupported shapes too.
+    impl, _ = resolve_attention_impl(
+        "scatter", "auto", 128, batch=8, dtype=jnp.float32, backend="cpu")
+    assert impl == "jnp"
+    impl, reason = resolve_attention_impl(
+        "scatter", "auto", 200, batch=1, dtype=jnp.float32, backend="tpu")
+    assert impl == "jnp" and "support" in reason
+
+
+def test_attention_ab_store_roundtrip(tmp_path, monkeypatch):
+    """record/merge semantics of the evidence store: per-bucket per-dtype
+    entries merge, the file is valid attention_ab/v1 JSON, and a corrupt
+    file degrades to no-opinion instead of raising."""
+    import json
+
+    from deepinteract_tpu.ops.pallas_attention import (
+        load_attention_ab,
+        measured_loss_reason,
+        record_attention_ab,
+    )
+
+    store = str(tmp_path / "ab.json")
+    monkeypatch.setenv("DI_ATTENTION_AB", store)
+    record_attention_ab(store, 8, 128, "float32", train_scan_speedup=0.99)
+    record_attention_ab(store, 8, 128, "float32", forward_speedup=1.3)
+    blob = json.load(open(store))
+    assert blob["schema"] == "attention_ab/v1"
+    assert blob["entries"]["b8_p128"]["float32"] == {
+        "train_scan_speedup": 0.99, "forward_speedup": 1.3}
+    assert measured_loss_reason(128, 8, jnp.float32)
+    assert not measured_loss_reason(128, 8, jnp.bfloat16)
+    # Decision-grade precedence: a scanned WIN overrides a noisy
+    # single-dispatch loss (±10-20% tunnel spread, BASELINE.md) — the
+    # scanned key decides alone when present.
+    record_attention_ab(store, 4, 128, "float32",
+                        train_scan_speedup=1.14, forward_speedup=0.90)
+    assert not measured_loss_reason(128, 4, jnp.float32)
+    # Without scanned evidence, single-dispatch numbers still guard.
+    record_attention_ab(store, 2, 128, "float32", forward_speedup=0.90)
+    assert measured_loss_reason(128, 2, jnp.float32)
+    with open(store, "w") as fh:
+        fh.write("{not json")
+    assert load_attention_ab(store) == {}
+    assert not measured_loss_reason(128, 8, jnp.float32)
 
 
 def test_gradient_parity_clip_saturation(rng):

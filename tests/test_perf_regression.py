@@ -138,6 +138,69 @@ def test_main_fails_loudly_on_unparseable_capture(tmp_path, capsys):
     assert "no valid bench contract" in out.err
 
 
+def test_timing_warning_widens_tolerance():
+    """ISSUE-10 satellite: a contract carrying ``timing_warning`` (the
+    shared timing core flagged unstable differenced samples) gets its
+    headline throughput tolerances widened instead of failing on noise —
+    and the widening is recorded on the entry, never silent."""
+    # A 40% drop is a regression under the normal 30% band...
+    dropped = dict(GOOD, value=19.8, vs_baseline=8.9)
+    verdict = compare(dropped, GOOD)
+    assert not verdict["ok"]
+    # ...but survives (with a recorded widening note) when the fresh
+    # capture says its own timing was unstable.
+    warned = dict(dropped, timing_warning=(
+        "scan_timing_protocol: linearity spread 0.40 across reps"))
+    verdict = compare(warned, GOOD)
+    assert verdict["ok"], verdict["regressions"]
+    assert any("timing_warning" in n for n in verdict["notes"])
+    # A 70% drop fails even at the widened (2x -> 60%) band: the widening
+    # absorbs noise, not cliffs.
+    cliff = dict(GOOD, value=9.0, vs_baseline=4.0, timing_warning="unstable")
+    verdict = compare(cliff, GOOD)
+    assert not verdict["ok"]
+    assert all(r.get("tolerance_widened") for r in verdict["regressions"]
+               if r["key"] in ("value", "vs_baseline"))
+    # Non-headline keys (bytes, screening) keep their tolerance: the
+    # warning describes the scan measurement, not the whole artifact.
+    screen_drop = dict(GOOD, timing_warning="unstable",
+                       screening={"screen_pairs_per_sec": 10.0,
+                                  "speedup_vs_naive": 1.0})
+    verdict = compare(screen_drop, GOOD)
+    assert not verdict["ok"]
+
+
+def test_blessed_repo_baseline_parses_and_covers_perf_keys():
+    """ISSUE-10 satellite: the committed PERF_BASELINE.json must parse as
+    a bench contract and carry the gating perf keys, so the NEXT round's
+    regressions fail loudly instead of falling back to the unrecoverable
+    BENCH_r05 tail / the r04 bucket-dump 'parsed' field."""
+    from tools.check_perf_regression import (
+        IDENTITY_KEYS,
+        TOLERANCES,
+        _flatten,
+        resolve_baseline,
+    )
+
+    blessed = REPO / "PERF_BASELINE.json"
+    assert blessed.exists(), "PERF_BASELINE.json not committed at repo root"
+    contract = recover_contract(str(blessed))
+    flat = _flatten(contract)
+    for key in IDENTITY_KEYS:
+        assert key in flat, f"blessed baseline lost identity key {key!r}"
+    gating = [k for k in TOLERANCES
+              if isinstance(flat.get(k), (int, float))
+              and not isinstance(flat.get(k), bool)]
+    # value/vs_baseline are the non-negotiable headline gates; the round-5
+    # reconstruction also carries analytic_train_mfu.
+    assert {"value", "vs_baseline"} <= set(gating)
+    assert len(gating) >= 3, f"blessed baseline gates too little: {gating}"
+    assert flat["value"] > 0 and flat["vs_baseline"] > 0
+    # And the repo-level resolution order actually picks it up.
+    _, path = resolve_baseline()
+    assert path.endswith("PERF_BASELINE.json")
+
+
 def test_update_blesses_fresh_contract(tmp_path, capsys):
     fresh = tmp_path / "fresh.log"
     blessed = tmp_path / "PERF_BASELINE.json"

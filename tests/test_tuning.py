@@ -498,10 +498,21 @@ def test_serving_engine_keeps_scan_chunks_with_checkpoint(tmp_path):
     be applied over it (adoption applies the safe subset and notes what it
     kept). Exercised on the adoption method directly — constructing a
     whole engine (jitted init + compiles) would buy nothing for this
-    config-level decision and costs real quick-tier wall time."""
+    config-level decision and costs real quick-tier wall time.
+
+    Gen-2 wrinkle: the engine's warmup-legality check
+    (ops/pallas_attention.supports_config, dtype/model-aware) strips a
+    tuned Pallas grid when the KERNEL itself is illegal for the model at
+    a warmup bucket — so the grid-adoption half runs on a kernel-legal
+    GT config, and the kernel-illegal tiny model pins the strip."""
+    import dataclasses
+
     from deepinteract_tpu.serving import EngineConfig, InferenceEngine
 
-    base_cfg = tiny_model_cfg()
+    base_cfg = dataclasses.replace(
+        tiny_model_cfg(),
+        gnn=GTConfig(num_layers=2, hidden=64, num_heads=4, shared_embed=8,
+                     dropout_rate=0.0))
     path = str(tmp_path / "store.json")
     store = TuningStore(path)
     store.put(runtime_key(model_signature(base_cfg), bucket_key(1, 64)),
@@ -509,20 +520,34 @@ def test_serving_engine_keeps_scan_chunks_with_checkpoint(tmp_path):
                                      pallas_fwd_blocks=2)))
     store.save()
 
-    def adopt(ckpt_dir):
+    def adopt(ckpt_dir, cfg_in):
         shell = object.__new__(InferenceEngine)
         shell.cfg = EngineConfig(warmup_buckets=((64, 64, 1),),
                                  tuning_store=path)
         shell.adopted_tuning = None
-        return shell, InferenceEngine._adopt_tuned(shell, base_cfg, ckpt_dir)
+        return shell, InferenceEngine._adopt_tuned(shell, cfg_in, ckpt_dir)
 
-    shell, cfg = adopt(ckpt_dir=str(tmp_path / "ckpt"))
+    shell, cfg = adopt(str(tmp_path / "ckpt"), base_cfg)
     assert shell.adopted_tuning is not None
     assert cfg.decoder.scan_chunks is True  # layout kept under a ckpt
     assert cfg.gnn.pallas_fwd_blocks == 2  # safe knobs still adopted
 
-    shell, cfg = adopt(ckpt_dir=None)
+    shell, cfg = adopt(None, base_cfg)
     assert cfg.decoder.scan_chunks is False  # no ckpt -> tuned layout
+
+    # Kernel-illegal model (hidden=16 is below the kernel's channel
+    # floor): the tuned grid is stripped — adopting block shapes for a
+    # kernel that can never run on this model would be meaningless — but
+    # the rest of the trial still adopts.
+    tiny = tiny_model_cfg()
+    store.put(runtime_key(model_signature(tiny), bucket_key(1, 64)),
+              make_entry(TrialConfig(scan_chunks=False,
+                                     pallas_fwd_blocks=2)))
+    store.save()
+    shell, cfg = adopt(None, tiny)
+    assert shell.adopted_tuning is not None
+    assert cfg.gnn.pallas_fwd_blocks is None
+    assert cfg.decoder.scan_chunks is False
 
 
 # ---------------------------------------------------------------------------
@@ -603,6 +628,28 @@ def test_compile_cache_enable(tmp_path):
     assert enable_compile_cache(None, log=msgs.append) is False
     # Leave the process-global config clean for other test modules.
     jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_timing_warning_flags_unstable_samples():
+    """ISSUE-10 satellite: the shared timing core must flag protocols
+    whose differenced samples are unstable — clamped reps, median
+    linearity outside the healthy band, or reps disagreeing with each
+    other (BENCH_r05 shipped headline numbers at linearity 1.53-1.93
+    with no comment) — and stay silent on healthy ones."""
+    from deepinteract_tpu.tuning.timing import timing_warning
+
+    healthy = {"linearity": 1.97, "linearity_spread": 0.1,
+               "clamped_samples": 0}
+    assert timing_warning(healthy) == ""
+    # Overhead-dominated regime: differenced signal degraded.
+    assert "outside healthy band" in timing_warning(
+        {"linearity": 1.30, "linearity_spread": 0.1, "clamped_samples": 0})
+    # Reps disagreeing about the regime (the r5 1.53-1.93 case).
+    assert "spread" in timing_warning(
+        {"linearity": 1.73, "linearity_spread": 0.40, "clamped_samples": 0})
+    # Clamped samples always warn.
+    assert "clamped" in timing_warning(
+        {"linearity": 2.0, "linearity_spread": 0.0, "clamped_samples": 1})
 
 
 def test_model_signature_excludes_tunables():

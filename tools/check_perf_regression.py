@@ -68,6 +68,22 @@ TOLERANCES = {
 # all (a different metric/unit is a different experiment, not a drift).
 IDENTITY_KEYS = ("metric", "unit")
 
+# When either side's contract carries a ``timing_warning`` (the shared
+# timing core flagged unstable differenced samples — linearity outside
+# the healthy band or reps disagreeing, tuning/timing.py), the headline
+# throughput keys were measured under a degraded protocol: widen their
+# tolerance instead of failing (or passing) on noise. Only the keys that
+# derive from the warned measurement widen; byte/attribution keys keep
+# their tolerance.
+TIMING_WARNED_KEYS = frozenset({
+    "value",
+    "vs_baseline",
+    "analytic_train_mfu",
+    "train_step_complexes_per_sec_b1_p128",
+    "train_scan_complexes_per_sec_min_sample",
+})
+TIMING_WARNED_FACTOR = 2.0
+
 
 def _flatten(record: dict, prefix: str = "") -> dict:
     """One level of nesting ("screening.screen_pairs_per_sec") is enough
@@ -139,6 +155,8 @@ def compare(fresh: dict, baseline: dict) -> dict:
                 "detail": "contract identity changed — runs are not "
                           "comparable (use --update to bless)",
             })
+    warned = bool(flat_fresh.get("timing_warning")
+                  or flat_base.get("timing_warning"))
     for key, (tol, direction) in TOLERANCES.items():
         if key not in flat_base:
             continue
@@ -155,10 +173,17 @@ def compare(fresh: dict, baseline: dict) -> dict:
         compared.append(key)
         if base_val == 0:
             continue
+        widened = warned and key in TIMING_WARNED_KEYS
+        if widened:
+            tol = tol * TIMING_WARNED_FACTOR
         rel = (new_val - float(base_val)) / abs(float(base_val))
         worse = -rel if direction > 0 else rel
         entry = {"key": key, "baseline": base_val, "fresh": new_val,
                  "rel_change": round(rel, 4), "tolerance": tol}
+        if widened:
+            entry["tolerance_widened"] = (
+                "timing_warning on the contract — unstable differenced "
+                "samples (tuning/timing.py)")
         if worse > tol:
             regressions.append(dict(entry, kind="perf"))
         elif -worse > tol:
@@ -171,6 +196,11 @@ def compare(fresh: dict, baseline: dict) -> dict:
                       "contract lost it (the \"parsed\": null class)",
         })
     notes = []
+    if warned:
+        notes.append(
+            "timing_warning present on a contract — headline throughput "
+            f"tolerances widened {TIMING_WARNED_FACTOR}x (unstable "
+            "differenced samples; see tuning/timing.py)")
     if not compared and not regressions:
         notes.append("no overlapping perf keys with the baseline (old "
                      "artifact format?) — nothing was actually compared; "

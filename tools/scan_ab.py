@@ -8,7 +8,15 @@ scanned dispatch is the stable protocol: this tool times the same
 prints per-step times + the ratio.
 
 Usage: python tools/scan_ab.py [batch] [pad] [dtype]   (defaults 8 128
-float32; dtype also accepts bfloat16 for the decoder activation path)
+float32; dtype also accepts bfloat16 for the END-TO-END policy —
+encoder + attention + decoder, models/policy.py)
+
+When DI_ATTENTION_AB points at an evidence file, the measured scanned
+speedup is RECORDED there (attention_ab/v1), and `attention_impl='auto'`
+routing consults it: a bucket where the kernel loses (<= 1.0x)
+demonstrably falls back to jnp with the reason logged
+(ops/pallas_attention.py:resolve_attention_impl) — the autotune guard
+ISSUE-10 added so a measured loss can never ship as the default again.
 """
 
 from __future__ import annotations
@@ -58,8 +66,11 @@ def main() -> int:
         model = DeepInteract(dataclasses.replace(
             base,
             gnn=dataclasses.replace(base.gnn, attention_impl=impl),
-            decoder=dataclasses.replace(base.decoder, remat=True,
-                                        compute_dtype=dtype),
+            decoder=dataclasses.replace(base.decoder, remat=True),
+            # End-to-end policy dtype (encoder + attention + decoder):
+            # the gen-2 kernel runs its MXU gathers in this dtype, so the
+            # A/B must measure the dtype it will route for.
+            compute_dtype=dtype,
         ))
         if "state" not in state_cache:
             state_cache["state"] = create_train_state(
@@ -97,6 +108,14 @@ def main() -> int:
 
     results["pallas_speedup_train_scan"] = (
         results["jnp"]["per_step_ms"] / results["pallas"]["per_step_ms"])
+    ab_path = os.environ.get("DI_ATTENTION_AB")
+    if ab_path:
+        from deepinteract_tpu.ops.pallas_attention import record_attention_ab
+
+        record_attention_ab(
+            ab_path, bs, pad, dtype,
+            train_scan_speedup=results["pallas_speedup_train_scan"])
+        results["evidence_recorded"] = ab_path
     print("RESULT " + json.dumps(results))
     return 0
 
