@@ -99,6 +99,7 @@ SECTION_EST_S = {
     "b1_p512_tiled": 480,
     "b1_p128_deeplab": 300,
     "screening": 300,
+    "saturation": 240,
     "attribution": 240,
 }
 
@@ -578,7 +579,7 @@ def _section_names(platform: str) -> list:
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
              "b1_p256", "b1_p384_tiled", "eval_path", "screening",
-             "attribution"]
+             "saturation", "attribution"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -1136,6 +1137,180 @@ def _run_screening_section(ctx, detail) -> None:
     _dump_partial(detail)
 
 
+def _run_saturation_section(ctx, detail) -> None:
+    """Overload behavior under deliberate oversubscription (ISSUE-11):
+    bounded admission queues + request deadlines + 429/Retry-After
+    rejection, measured end to end through the engine's batched path.
+
+    Protocol (all CPU-runnable; absolute figures are device-dependent,
+    the RATIO is the contract):
+
+    1. warm every batch-slot executable the run can hit, then measure the
+       UNSATURATED baseline with a closed loop of ``max_batch`` workers —
+       the same coalescing regime the saturated phase runs in, so the
+       p99 comparison isolates queueing, not batching;
+    2. drive an OPEN loop at ``DI_BENCH_SAT_OVERSUB`` (default 4x) times
+       the measured unsaturated throughput for ``DI_BENCH_SAT_SECONDS``
+       against bounded queues (``max_queue_depth == max_batch``: at most
+       one full extra batch of queueing, which is what keeps served p99
+       inside ~2x the unsaturated p99 while ALL excess load is rejected
+       at submit time with a computed retry_after_s);
+    3. record served-vs-rejected counts, served p50/p99, the p99 ratio,
+       and deadline accounting (every request carries a deadline; zero
+       should expire when rejection keeps the queue bounded)."""
+    import threading as _threading
+
+    from deepinteract_tpu.screening import ChainLibrary
+    from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+    from deepinteract_tpu.serving.admission import (
+        Deadline,
+        DeadlineExceeded,
+        Overloaded,
+    )
+
+    oversub = float(os.environ.get("DI_BENCH_SAT_OVERSUB", "4"))
+    duration_s = float(os.environ.get("DI_BENCH_SAT_SECONDS", "8"))
+    unsat_requests = int(os.environ.get("DI_BENCH_SAT_UNSAT", "24"))
+    max_batch = 4
+    library = ChainLibrary.synthetic(2, 40, 60, seed=13)
+    ids = list(library.ids())
+    raw = {"graph1": library[ids[0]].raw, "graph2": library[ids[1]].raw,
+           "examples": np.zeros((0, 3), np.int32)}
+    engine = InferenceEngine(
+        ctx["make_model"]().cfg,
+        cfg=EngineConfig(max_batch=max_batch, max_delay_ms=2.0,
+                         result_cache_size=0,
+                         max_queue_depth=max_batch, max_inflight=64))
+    entry = {"oversubscription": oversub, "duration_s": duration_s,
+             "max_batch": max_batch,
+             "max_queue_depth": engine.cfg.max_queue_depth,
+             "interaction_stem": engine.model.cfg.interaction_stem,
+             "compute_dtype": ctx["bench_dtype"]}
+    detail["saturation"] = entry
+    try:
+        # Warm every coalesced-batch slot size (1, 2, 4) the phases can
+        # hit, so neither measurement pays compile luck.
+        engine.warmup([(64, 64, s) for s in (1, 2, 4)])
+        _dump_partial(detail)
+
+        # Unsaturated baseline: closed loop, max_batch concurrent
+        # clients (no queue growth by construction).
+        lat_lock = _threading.Lock()
+        unsat_lat = []
+
+        def closed_worker(n):
+            for _ in range(n):
+                t0 = time.perf_counter()
+                engine.predict(raw)
+                with lat_lock:
+                    unsat_lat.append(time.perf_counter() - t0)
+
+        per_worker = max(1, unsat_requests // max_batch)
+        threads = [_threading.Thread(target=closed_worker,
+                                     args=(per_worker,))
+                   for _ in range(max_batch)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        unsat_s = time.perf_counter() - t0
+        unsat_lat.sort()
+        unsat_p50 = unsat_lat[len(unsat_lat) // 2]
+        unsat_p99 = unsat_lat[min(len(unsat_lat) - 1,
+                                  int(0.99 * len(unsat_lat)))]
+        unsat_rps = len(unsat_lat) / unsat_s
+        entry["unsat_p50_ms"] = round(unsat_p50 * 1e3, 2)
+        entry["unsat_p99_ms"] = round(unsat_p99 * 1e3, 2)
+        entry["unsat_served_per_sec"] = round(unsat_rps, 3)
+        _dump_partial(detail)
+
+        # Saturated phase: open loop at oversub x the measured rate,
+        # every request carrying a deadline comfortably above the
+        # BOUNDED queue's worst case (the point is that rejection — not
+        # deadline expiry — absorbs the excess).
+        offered_rps = oversub * unsat_rps
+        interval = 1.0 / offered_rps
+        deadline_budget = max(2.0, 20.0 * unsat_p99)
+        served_lat = []
+        failed = {"deadline": 0, "other": 0}
+        rejected = []
+        futs = []
+
+        def on_done(fut, t_sub):
+            exc = fut.exception()
+            with lat_lock:
+                if exc is None:
+                    served_lat.append(time.perf_counter() - t_sub)
+                elif isinstance(exc, DeadlineExceeded):
+                    failed["deadline"] += 1
+                else:
+                    failed["other"] += 1
+
+        t_start = time.monotonic()
+        next_t = t_start
+        while time.monotonic() - t_start < duration_s:
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.002))
+                continue
+            next_t += interval
+            t_sub = time.perf_counter()
+            try:
+                fut = engine.submit(raw,
+                                    deadline=Deadline.after(deadline_budget))
+            except Overloaded as exc:
+                rejected.append(exc.retry_after_s)
+                continue
+            except DeadlineExceeded:
+                # Under lat_lock: done-callbacks on the flush worker
+                # update the same dict concurrently.
+                with lat_lock:
+                    failed["deadline"] += 1
+                continue
+            fut.add_done_callback(lambda f, t=t_sub: on_done(f, t))
+            futs.append(fut)
+        for fut in futs:
+            try:
+                fut.result(timeout=deadline_budget + 10.0)
+            except Exception:
+                pass  # already tallied by the callback
+
+        served_lat.sort()
+        served = len(served_lat)
+        offered = served + len(rejected) + failed["deadline"] + failed["other"]
+        entry["offered_per_sec"] = round(offered_rps, 3)
+        entry["offered"] = offered
+        entry["served"] = served
+        entry["rejected"] = len(rejected)
+        entry["deadline_expired"] = failed["deadline"]
+        entry["failed_other"] = failed["other"]
+        entry["reject_rate"] = round(len(rejected) / max(1, offered), 3)
+        if rejected:
+            entry["retry_after_s_median"] = round(
+                sorted(rejected)[len(rejected) // 2], 3)
+        if served:
+            p50 = served_lat[served // 2]
+            p99 = served_lat[min(served - 1, int(0.99 * served))]
+            entry["served_p50_ms"] = round(p50 * 1e3, 2)
+            entry["served_p99_ms"] = round(p99 * 1e3, 2)
+            entry["served_per_sec"] = round(served / duration_s, 3)
+            entry["p99_ratio"] = round(p99 / max(unsat_p99, 1e-9), 2)
+        entry["admission"] = engine.admission.stats()
+        entry["note"] = (
+            "open-loop oversubscription vs a closed-loop unsaturated "
+            "baseline in the same coalescing regime; p99_ratio is the "
+            "bounded-queue contract (excess load rejected 429-style at "
+            "admission, never queued unboundedly)")
+    finally:
+        engine.close()
+    _log(json.dumps({"saturation": {
+        k: entry.get(k) for k in (
+            "served", "rejected", "deadline_expired", "served_p99_ms",
+            "unsat_p99_ms", "p99_ratio", "served_per_sec", "reject_rate")}}))
+    _dump_partial(detail)
+
+
 def _run_attribution_section(ctx, detail) -> None:
     """Device-time attribution of the serving forward (ISSUE-8): capture
     a jax.profiler trace around a few warm predicts, parse it to per-op
@@ -1217,7 +1392,7 @@ def _section_result_key(name: str):
     if name == "eval_path":
         return None, "eval_path_b128"
     if name in ("tuned_ab", "stem_ab", "precision_ab", "screening",
-                "attribution"):
+                "saturation", "attribution"):
         return None, name
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
@@ -1248,6 +1423,8 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_precision_ab_section(ctx, detail)
     elif name == "screening":
         _run_screening_section(ctx, detail)
+    elif name == "saturation":
+        _run_saturation_section(ctx, detail)
     elif name == "attribution":
         _run_attribution_section(ctx, detail)
     elif name.startswith("ab_p"):
@@ -1353,6 +1530,18 @@ def _build_headline(detail, scan_k) -> dict:
         if "remask" in attribution:
             line["attribution"]["remask_share"] = (
                 attribution["remask"].get("share"))
+    saturation = detail.get("saturation", {})
+    if "served_p99_ms" in saturation:
+        # Overload-safety contract keys (ISSUE-11): bounded-queue p99
+        # ratio under oversubscription, served-vs-rejected split, and
+        # deadline accounting — the driver artifact shows the server
+        # degrades by REJECTING, not by queueing unboundedly.
+        line["saturation"] = {
+            k: saturation[k]
+            for k in ("p99_ratio", "served_p99_ms", "unsat_p99_ms",
+                      "served_per_sec", "reject_rate", "served",
+                      "rejected", "deadline_expired", "oversubscription")
+            if k in saturation}
     screening = detail.get("screening", {})
     if "screen_pairs_per_sec" in screening:
         # The bulk-screening workload's own throughput row (ISSUE-6):
@@ -1381,7 +1570,7 @@ def _is_partial(detail) -> bool:
     candidates += [v for k, v in detail.items()
                    if k.startswith(("attention_ab", "eval_path", "tuned_ab",
                                     "stem_ab", "precision_ab", "screening",
-                                    "attribution"))
+                                    "saturation", "attribution"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates
                if isinstance(c, dict))

@@ -236,6 +236,36 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
                         "disables)")
     g.add_argument("--request_timeout_s", type=float, default=120.0,
                    help="per-request wait bound inside the HTTP handler")
+    g.add_argument("--max_queue_depth", type=int, default=64,
+                   help="admission control: max pending requests PER "
+                        "shape bucket; submits beyond it are rejected "
+                        "429 with Retry-After (serving/admission.py)")
+    g.add_argument("--max_inflight", type=int, default=256,
+                   help="admission control: max admitted-but-unanswered "
+                        "requests across all buckets (global cap)")
+    g.add_argument("--default_deadline_ms", type=float, default=0.0,
+                   help="request deadline applied when the client sends "
+                        "neither X-Request-Deadline-Ms nor deadline_s; "
+                        "expired requests fail 504 before burning a "
+                        "device dispatch (0 disables)")
+    g.add_argument("--shed_enter_util", type=float, default=0.9,
+                   help="load shedding: enter degraded mode (429 on POST "
+                        "routes, /healthz 'overloaded') when in-flight/"
+                        "max_inflight reaches this fraction")
+    g.add_argument("--shed_exit_util", type=float, default=0.5,
+                   help="load shedding: leave degraded mode once "
+                        "utilization falls back under this fraction "
+                        "(hysteresis; must be <= --shed_enter_util)")
+    g.add_argument("--shed_min_degraded_s", type=float, default=2.0,
+                   help="minimum dwell in degraded mode before recovery "
+                        "is considered (anti-flap)")
+    g.add_argument("--no_load_shedding", action="store_true",
+                   help="disable the degraded-mode shedder (bounded "
+                        "queues still reject 429 at admission)")
+    g.add_argument("--screen_max_pairs", type=int, default=512,
+                   help="largest synchronous POST /screen (pairs); "
+                        "bigger screens are refused 400 toward "
+                        "cli/screen.py (manifest + resume)")
     g.add_argument("--events_out", type=str, default=None,
                    help="span event log (JSONL) for request-scoped "
                         "tracing: every traced request's queue-wait/"

@@ -90,6 +90,8 @@ def main(argv=None) -> int:
         diagonal_buckets=args.diagonal_buckets,
         pad_to_max_bucket=args.pad_to_max_bucket,
         input_indep=args.input_indep,
+        max_queue_depth=args.max_queue_depth,
+        max_inflight=args.max_inflight,
         tuning_store=tuning_store,
         # Explicitly typed --interaction_stem / --compute_dtype survive
         # tuned-entry adoption (tuning/consume.respect_explicit).
@@ -103,8 +105,20 @@ def main(argv=None) -> int:
         seed=args.seed,
         metric_to_track=args.metric_to_track,
     )
-    server = ServingServer(engine, host=args.host, port=args.port,
-                           request_timeout_s=args.request_timeout_s)
+    from deepinteract_tpu.serving import ShedderConfig
+
+    server = ServingServer(
+        engine, host=args.host, port=args.port,
+        request_timeout_s=args.request_timeout_s,
+        screen_max_pairs=args.screen_max_pairs,
+        default_deadline_ms=args.default_deadline_ms,
+        shedder_cfg=ShedderConfig(
+            enabled=not args.no_load_shedding,
+            enter_utilization=args.shed_enter_util,
+            exit_utilization=args.shed_exit_util,
+            min_degraded_s=args.shed_min_degraded_s,
+        ),
+    )
     host, port = server.address
     stats = engine.stats()
     print(f"serving on http://{host}:{port} "

@@ -240,6 +240,12 @@ def main(argv=None) -> int:
         # checkpoint is flushed; the scheduler restarts us with --resume.
         print(f"training preempted ({exc}); checkpoint state is flushed — "
               f"rerun with --resume to continue from epoch boundaries")
+        # Leave TOGETHER: rank-0 just spent seconds draining checkpoints
+        # the peers did not — exiting staggered races the coordination
+        # service's shutdown handshake (parallel/multihost.exit_barrier).
+        from deepinteract_tpu.parallel.multihost import exit_barrier
+
+        exit_barrier("preempted-exit")
         return 0
 
     # Publish the checkpoint directory as this run's model artifact
@@ -260,6 +266,9 @@ def main(argv=None) -> int:
     )
     if is_primary_host():
         print({k: round(v, 4) for k, v in test_metrics.items()})
+    from deepinteract_tpu.parallel.multihost import exit_barrier
+
+    exit_barrier("train-exit")
     return 0
 
 

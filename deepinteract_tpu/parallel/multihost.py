@@ -150,6 +150,43 @@ def is_primary_host() -> bool:
     return jax.process_index() == 0
 
 
+def exit_barrier(tag: str = "exit") -> None:
+    """Cross-host rendezvous + coordinated distributed shutdown before
+    process exit; no-op single-process.
+
+    Hosts leave ``cli.train`` at different times (rank-0's checkpoint/
+    CSV/compile-cache atexit work vs the peers' immediate return —
+    widest on the preemption path), and jax's OWN atexit
+    ``distributed.shutdown`` runs a two-sided coordination-service
+    barrier with a timeout: when one host's interpreter teardown is
+    slow, the other times out at that barrier and the runtime
+    **aborts the process** ("Shutdown barrier in coordination service
+    has failed" → SIGABRT; observed flakily in the 2-proc
+    kill-after-save chaos test). The fix is to run the handshake while
+    the hosts are still ALIGNED: an explicit collective rendezvous,
+    then ``jax.distributed.shutdown()`` immediately — which also makes
+    jax's atexit hook a no-op, so per-host teardown skew afterwards no
+    longer involves the coordination service at all. Best-effort: a
+    failure here must not turn a finished (or cleanly preempted) run
+    into a crash. No jax collectives may run after this call."""
+    if jax.process_count() <= 1:
+        return
+    import logging
+
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+    except Exception as exc:  # pragma: no cover - peer already gone
+        logging.getLogger(__name__).warning(
+            "exit barrier %r failed (peer already down?): %s", tag, exc)
+    try:
+        jax.distributed.shutdown()
+    except Exception as exc:  # pragma: no cover - best effort
+        logging.getLogger(__name__).warning(
+            "distributed shutdown after barrier %r failed: %s", tag, exc)
+
+
 def host_local_array(x):
     """A global ``jax.Array`` -> this host's local numpy view.
 
@@ -170,7 +207,14 @@ def host_local_array(x):
 
     if getattr(x, "is_fully_addressable", True):
         return np.asarray(x)
-    shards = {s.index: np.asarray(s.data) for s in x.addressable_shards}
+    # Deduplicate by each shard's START OFFSETS, not its raw index tuple:
+    # slices are unhashable before Python 3.12, and the offsets are the
+    # identity the grid reassembly needs anyway (replicating axes yield
+    # duplicate offsets — dropped here by construction).
+    shards = {
+        tuple((sl.start or 0) for sl in s.index): np.asarray(s.data)
+        for s in x.addressable_shards
+    }
     if len(shards) == 1:  # replicated (or scalar): one distinct index
         return next(iter(shards.values()))
     # GSPMD shards tile a regular grid; reassemble this host's sub-grid
@@ -178,12 +222,12 @@ def host_local_array(x):
     # back smaller than the global dim — callers that need full coverage
     # must validate the returned shape (Trainer.evaluate does).
     starts = [
-        sorted({(idx[a].start or 0) for idx in shards}) for a in range(x.ndim)
+        sorted({idx[a] for idx in shards}) for a in range(x.ndim)
     ]
     pos = [{st: i for i, st in enumerate(s)} for s in starts]
     blocks = np.empty([len(s) for s in starts], dtype=object)
     for idx, data in shards.items():
-        blocks[tuple(pos[a][idx[a].start or 0] for a in range(x.ndim))] = data
+        blocks[tuple(pos[a][idx[a]] for a in range(x.ndim))] = data
     if any(b is None for b in blocks.ravel()):
         raise ValueError(
             "host_local_array: local shards do not tile a complete grid; "

@@ -24,6 +24,12 @@ Registered sites:
   train batch
 * ``checkpoint.snapshot`` — raises RESOURCE_EXHAUSTED at the async
   checkpoint's on-device snapshot (the transient second state copy)
+* ``serving.admission``  — raises a typed Overloaded at engine submit
+* ``serving.assembly``   — raises BatchExecutionError while the flush
+  worker featurizes/stacks a coalesced batch
+* ``serving.dispatch``   — raises BatchExecutionError at the coalesced
+  batch's device dispatch (fails only that group; the worker and the
+  engine keep serving — tests/test_serving.py chaos suite)
 
 When no plan is configured every probe is a dict lookup on an empty map —
 effectively free on hot paths.

@@ -35,6 +35,7 @@ from deepinteract_tpu.data.graph import pad_graph, stack_graphs
 from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.obs import spans as obs_spans
 from deepinteract_tpu.screening.embcache import EmbeddingCache, chain_hash
+from deepinteract_tpu.serving.admission import DeadlineExceeded, expired_counter
 from deepinteract_tpu.screening.library import ChainLibrary
 from deepinteract_tpu.screening.manifest import ScreenManifest, pair_id
 from deepinteract_tpu.screening.scoring import pair_summary, rank_records
@@ -159,10 +160,14 @@ class ScreenRunner:
     # -- encode phase ------------------------------------------------------
 
     def ensure_embeddings(self, library: ChainLibrary,
-                          chain_ids: Sequence[str]):
+                          chain_ids: Sequence[str],
+                          deadline=None):
         """Encode every chain in ``chain_ids`` not already cached.
         Returns (chain_id -> (feats, n, bucket), encodes_executed,
-        cache_hits, encode_batches)."""
+        cache_hits, encode_batches). ``deadline`` (a
+        ``serving.admission.Deadline``) is checked before each encoder
+        dispatch — an expired budget raises :class:`DeadlineExceeded`
+        instead of burning more device work for a client that gave up."""
         out: Dict[str, Tuple[np.ndarray, int, int]] = {}
         todo = defaultdict(list)  # (bucket, sig) -> [(id, key, entry)]
         hits = 0
@@ -182,6 +187,11 @@ class ScreenRunner:
         for (bucket, sig), items in sorted(todo.items(),
                                            key=lambda kv: kv[0][:1]):
             for lo in range(0, len(items), self.cfg.encode_batch):
+                if deadline is not None and deadline.expired:
+                    expired_counter("screen")
+                    raise DeadlineExceeded(
+                        f"screen deadline ({deadline.budget_s * 1e3:.0f}ms)"
+                        f" expired during encode ({executed} chains done)")
                 chunk = items[lo:lo + self.cfg.encode_batch]
                 slots = _slots(len(chunk), self.cfg.encode_batch)
                 graphs = [self._padded_graph(e, bucket)
@@ -212,6 +222,7 @@ class ScreenRunner:
         guard=None,
         after_batch: Optional[Callable[[int], None]] = None,
         trace_id: str = "",
+        deadline=None,
     ) -> ScreenResult:
         """Score ``pairs`` (chain-id tuples); see module docstring.
 
@@ -220,7 +231,12 @@ class ScreenRunner:
         ``after_batch(num_batches)`` is a test hook (fault injection).
         ``trace_id`` (request-scoped tracing, obs/reqtrace.py) labels
         this screen's span events so one id connects the HTTP response,
-        ``events.jsonl``, and the phase histograms."""
+        ``events.jsonl``, and the phase histograms. ``deadline`` (a
+        ``serving.admission.Deadline``; the synchronous ``POST /screen``
+        path) is enforced at encode- and decode-batch boundaries —
+        expiry raises :class:`DeadlineExceeded` (manifest-backed CLI
+        screens keep using ``guard`` + resume instead: their half-done
+        work is durable, a synchronous HTTP screen's is not)."""
         trace_attrs = {"trace_id": trace_id} if trace_id else {}
         resumed_pairs = 0
         resumed = False
@@ -235,7 +251,7 @@ class ScreenRunner:
         with obs_spans.span("screen_encode", chains=len(needed),
                             **trace_attrs):
             emb, executed, enc_hits, enc_batches = self.ensure_embeddings(
-                library, needed)
+                library, needed, deadline=deadline)
         encode_s = time.perf_counter() - t0
 
         # Pairs are oriented so bucket1 <= bucket2: the top-k summary is
@@ -265,6 +281,13 @@ class ScreenRunner:
                         preempted = True
                         _PREEMPTIONS.inc()
                         break
+                    if deadline is not None and deadline.expired:
+                        expired_counter("screen")
+                        raise DeadlineExceeded(
+                            "screen deadline "
+                            f"({deadline.budget_s * 1e3:.0f}ms) expired "
+                            f"during decode ({scored}/{len(pairs)} pairs "
+                            "scored)")
                     chunk = items[lo:lo + self.cfg.decode_batch]
                     slots = _slots(len(chunk), self.cfg.decode_batch)
                     rows = chunk + [chunk[0]] * (slots - len(chunk))

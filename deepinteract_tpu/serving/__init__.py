@@ -7,17 +7,35 @@ preemption. See ``engine.py`` for the amortization model and
 ``server.py`` for the wire protocol.
 """
 
+from deepinteract_tpu.serving.admission import (
+    AdmissionController,
+    BatchExecutionError,
+    Deadline,
+    DeadlineExceeded,
+    LoadShedder,
+    Overloaded,
+    ShedderConfig,
+    ShuttingDown,
+)
 from deepinteract_tpu.serving.cache import ResultCache, content_hash
 from deepinteract_tpu.serving.engine import EngineConfig, InferenceEngine
 from deepinteract_tpu.serving.scheduler import MicroBatchScheduler, SchedulerClosed
 from deepinteract_tpu.serving.server import ServingServer
 
 __all__ = [
+    "AdmissionController",
+    "BatchExecutionError",
+    "Deadline",
+    "DeadlineExceeded",
     "EngineConfig",
     "InferenceEngine",
+    "LoadShedder",
     "MicroBatchScheduler",
+    "Overloaded",
     "ResultCache",
     "SchedulerClosed",
+    "ShedderConfig",
+    "ShuttingDown",
     "ServingServer",
     "content_hash",
 ]

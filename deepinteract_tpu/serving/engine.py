@@ -38,6 +38,7 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -47,6 +48,15 @@ from deepinteract_tpu.data.graph import stack_complexes
 from deepinteract_tpu.data.io import complex_lengths, to_paired_complex
 from deepinteract_tpu.data.loader import make_bucket_fn
 from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.serving.admission import (
+    AdmissionController,
+    BatchExecutionError,
+    Deadline,
+    DeadlineExceeded,
+    Overloaded,
+    expired_counter,
+)
 from deepinteract_tpu.serving.cache import ResultCache, content_hash
 from deepinteract_tpu.serving.scheduler import MicroBatchScheduler
 
@@ -72,6 +82,11 @@ _COMPILES = obs_metrics.counter(
     "Cold executable compiles (one per new bucket/batch key)")
 _COMPILE_SECONDS = obs_metrics.histogram(
     "di_serving_compile_seconds", "Wall time of each cold compile")
+# Load-shedder signal: >0 while a cold compile holds the exec lock (a
+# long compile stalls every flush behind it — exactly when shedding is
+# cheaper than queueing).
+_COMPILE_INFLIGHT = obs_metrics.gauge(
+    "di_serving_compile_inflight", "Cold compiles currently in progress")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +110,12 @@ class EngineConfig:
     # Zero all input features (the scientific-control path); part of the
     # result-cache key since it changes the output for the same upload.
     input_indep: bool = False
+    # Overload bounds (serving/admission.py): per-bucket pending-queue
+    # cap and global admitted-in-flight cap. Submits beyond either raise
+    # a typed Overloaded with a computed retry_after_s instead of
+    # queueing unboundedly.
+    max_queue_depth: int = 64
+    max_inflight: int = 256
     # Pin the model's configured interaction_stem / compute_dtype against
     # tuned-entry adoption (cli/serve.py sets these when the operator
     # typed the flags explicitly — a stored trial must not silently
@@ -171,9 +192,14 @@ class InferenceEngine:
         self._jit_decode = jax.jit(self._decode)
         if cfg.warmup_buckets:
             self.warmup(cfg.warmup_buckets)
+        self.admission = AdmissionController(
+            max_queue_depth=cfg.max_queue_depth,
+            max_inflight=cfg.max_inflight)
         self.scheduler = MicroBatchScheduler(
             self._flush, max_batch=cfg.max_batch,
-            max_delay_ms=cfg.max_delay_ms)
+            max_delay_ms=cfg.max_delay_ms,
+            admission=self.admission,
+            on_expired=self._expired_in_queue)
 
     # -- autotuning --------------------------------------------------------
 
@@ -427,7 +453,11 @@ class InferenceEngine:
             if cached is not None:
                 return cached
             t0 = time.perf_counter()
-            compiled = jit_fn.lower(*args).compile()
+            _COMPILE_INFLIGHT.inc()
+            try:
+                compiled = jit_fn.lower(*args).compile()
+            finally:
+                _COMPILE_INFLIGHT.dec()
             self._executables[key] = compiled
             elapsed = time.perf_counter() - t0
             self._compile_seconds[label] = elapsed
@@ -500,16 +530,49 @@ class InferenceEngine:
                         int(g["edge_feats"].shape[2])))
         return tuple(sig)
 
-    def submit(self, raw: Dict, reqtrace=None) -> Future:
+    def _expired_in_queue(self, payload: Dict, deadline) -> Exception:
+        """Scheduler ``on_expired`` hook: build the typed failure for a
+        deadline-swept request, with its trace decomposition attached
+        (``device_ms == 0`` by construction — it never dispatched)."""
+        trace = None
+        rt = payload.get("reqtrace")
+        if rt is not None:
+            rt.set_phase("queue_wait", rt.since("submit"))
+            trace = rt.finish(deadline=deadline.budget_s,
+                              deadline_remaining=0.0)
+        return DeadlineExceeded(
+            f"deadline ({deadline.budget_s * 1e3:.0f}ms) expired while "
+            "queued; dropped before batch assembly", trace=trace)
+
+    def submit(self, raw: Dict, reqtrace=None,
+               deadline: Optional[Deadline] = None) -> Future:
         """Future-returning enqueue. ``raw`` is a loaded complex dict
         (``data/io.py`` schema: graph1/graph2/examples). ``reqtrace`` is
         an optional :class:`deepinteract_tpu.obs.reqtrace.RequestTrace`
         carried through the scheduler queue to the flush; when given, the
         result dict gains a ``trace`` decomposition (queue-wait /
         assembly / compile / device) under the request's ``trace_id``.
+        ``deadline`` (serving/admission.py) is checked here, at the
+        scheduler's batch-assembly sweep, and bounds ``predict``'s wait.
+
+        Raises ``Overloaded`` (bounded queues full — typed, with
+        ``retry_after_s``) or ``DeadlineExceeded`` (already expired at
+        admission); the returned future can additionally fail with either
+        plus ``BatchExecutionError``/``ShuttingDown``.
 
         Result contract: ``probs`` is a READ-ONLY array (it may be shared
         with the result cache) — ``.copy()`` it before mutating."""
+        faults.maybe_raise(
+            "serving.admission",
+            lambda: Overloaded("injected admission fault",
+                               retry_after_s=self.admission.retry_after_s()))
+        if deadline is not None and deadline.expired:
+            # Dead on arrival — even a cache hit is wasted bytes for a
+            # client that already gave up.
+            expired_counter("admission")
+            raise DeadlineExceeded(
+                f"deadline ({deadline.budget_s * 1e3:.0f}ms) already "
+                "expired at admission")
         key = None
         if self.cache.capacity > 0:  # don't hash MBs for a disabled cache
             key = content_hash(raw,
@@ -532,14 +595,31 @@ class InferenceEngine:
         return self.scheduler.submit(
             (b1, b2) + self._shape_signature(raw),
             {"raw": raw, "n1": n1, "n2": n2, "cache_key": key,
-             "reqtrace": reqtrace},
+             "reqtrace": reqtrace, "deadline": deadline},
+            deadline=deadline,
         )
 
     def predict(self, raw: Dict, timeout: Optional[float] = None,
-                reqtrace=None) -> Dict:
+                reqtrace=None, deadline: Optional[Deadline] = None) -> Dict:
         """Blocking single-complex prediction through the same batched
-        path (so even sequential callers share warm executables)."""
-        return self.submit(raw, reqtrace=reqtrace).result(timeout=timeout)
+        path (so even sequential callers share warm executables). With a
+        ``deadline``, the wait is bounded by it (plus a small grace for
+        the scheduler's sweep to answer) — a caller never hangs past its
+        deadline even if the flush worker is stuck in a long compile."""
+        fut = self.submit(raw, reqtrace=reqtrace, deadline=deadline)
+        if deadline is not None:
+            bound = deadline.remaining_s() + 0.25
+            timeout = bound if timeout is None else min(timeout, bound)
+            try:
+                return fut.result(timeout=timeout)
+            except FuturesTimeout:
+                # The future is still pending (e.g. its group is mid-
+                # dispatch); the client's budget is spent either way.
+                expired_counter("wait")
+                raise DeadlineExceeded(
+                    f"deadline ({deadline.budget_s * 1e3:.0f}ms) expired "
+                    "while waiting for the result") from None
+        return fut.result(timeout=timeout)
 
     def _flush(self, bucket_key, items) -> list:
         """One coalesced device dispatch for same-bucket requests — runs on
@@ -557,21 +637,45 @@ class InferenceEngine:
             if rt is not None:
                 rt.set_phase("queue_wait", rt.since("submit"))
         b1, b2 = bucket_key[0], bucket_key[1]
-        complexes = [
-            to_paired_complex(it["raw"], n_pad1=b1, n_pad2=b2,
-                              input_indep=self.cfg.input_indep)
-            for it in items
-        ]
-        slots = self._batch_slots(len(complexes))
-        pad_slots = slots - len(complexes)
-        complexes.extend([complexes[0]] * pad_slots)
-        batch = stack_complexes(complexes)
+        try:
+            faults.maybe_raise(
+                "serving.assembly",
+                lambda: BatchExecutionError("injected batch-assembly fault",
+                                            stage="assembly"))
+            complexes = [
+                to_paired_complex(it["raw"], n_pad1=b1, n_pad2=b2,
+                                  input_indep=self.cfg.input_indep)
+                for it in items
+            ]
+            slots = self._batch_slots(len(complexes))
+            pad_slots = slots - len(complexes)
+            complexes.extend([complexes[0]] * pad_slots)
+            batch = stack_complexes(complexes)
+        except BatchExecutionError:
+            raise
+        except Exception as exc:
+            raise BatchExecutionError(
+                f"batch assembly failed: {exc}", stage="assembly") from exc
         t_assembled = time.perf_counter()
         compiled = self._executable_for(tuple(bucket_key) + (slots,), batch)
         t_compiled = time.perf_counter()
-        probs = np.asarray(
-            compiled(self.params, self.batch_stats, batch.graph1, batch.graph2)
-        )
+        try:
+            faults.maybe_raise(
+                "serving.dispatch",
+                lambda: BatchExecutionError("injected device-dispatch fault",
+                                            stage="dispatch"))
+            probs = np.asarray(
+                compiled(self.params, self.batch_stats,
+                         batch.graph1, batch.graph2)
+            )
+        except BatchExecutionError:
+            raise
+        except Exception as exc:
+            # Typed so clients (and tests) can tell "your batch died" from
+            # "your upload was bad"; the scheduler fails ONLY this group
+            # and its worker keeps serving (di_serving_batch_failures).
+            raise BatchExecutionError(
+                f"device dispatch failed: {exc}", stage="dispatch") from exc
         t_fetched = time.perf_counter()
         for rt in traces:
             if rt is not None:
@@ -613,7 +717,15 @@ class InferenceEngine:
                 self.cache.put(it["cache_key"], dict(result))
             rt = traces[i]
             if rt is not None:
-                result["trace"] = rt.finish(coalesced=len(items))
+                extra = {}
+                dl = it.get("deadline")
+                if dl is not None:
+                    # Per-request deadline accounting in the PR-7
+                    # decomposition: the budget and what was left of it
+                    # when the result came back.
+                    extra = {"deadline": dl.budget_s,
+                             "deadline_remaining": dl.remaining_s()}
+                result["trace"] = rt.finish(coalesced=len(items), **extra)
             results.append(result)
         return results
 
@@ -653,5 +765,6 @@ class InferenceEngine:
             "executed_requests": executed_requests,
             "padded_slots": padded_slots,
             "scheduler": self.scheduler.stats(),
+            "admission": self.admission.stats(),
             "result_cache": self.cache.stats(),
         }

@@ -42,6 +42,16 @@ that ``trace_id``.
   derived from the same registry histogram the exposition serves, so the
   two endpoints agree by construction.
 
+Overload discipline (``serving/admission.py``): the engine's bounded
+queues reject excess submits with a typed ``Overloaded`` -> **429 +
+``Retry-After``**; per-request deadlines (``X-Request-Deadline-Ms``
+header or ``deadline_s`` JSON field, default ``--default_deadline_ms``)
+are enforced end to end -> **504** when the budget expires; and an
+adaptive :class:`LoadShedder` flips the server into degraded mode under
+sustained pressure — POST routes answer 429 before any parse work,
+``/healthz`` reports ``{"status": "overloaded"}``, and ``/stats`` /
+``/metrics`` stay live — with hysteresis so it recovers cleanly.
+
 Shutdown: ``run()`` installs the PR-1 :class:`PreemptionGuard`; on
 SIGTERM/SIGINT the server stops accepting (``503`` on new predicts),
 drains in-flight requests through the scheduler, answers their responses,
@@ -66,6 +76,14 @@ from deepinteract_tpu.obs import expfmt
 from deepinteract_tpu.obs import metrics as obs_metrics
 from deepinteract_tpu.obs.reqtrace import RequestTrace
 from deepinteract_tpu.robustness.preemption import PreemptionGuard
+from deepinteract_tpu.serving.admission import (
+    Deadline,
+    DeadlineExceeded,
+    LoadShedder,
+    Overloaded,
+    ShedderConfig,
+    ShuttingDown,
+)
 from deepinteract_tpu.serving.engine import InferenceEngine
 from deepinteract_tpu.serving.scheduler import SchedulerClosed
 
@@ -159,12 +177,24 @@ class ServingServer:
 
     def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
                  port: int = 8008, request_timeout_s: float = 120.0,
-                 screen_max_pairs: int = 512):
+                 screen_max_pairs: int = 512,
+                 default_deadline_ms: float = 0.0,
+                 shedder_cfg: Optional[ShedderConfig] = None):
         self.engine = engine
         self.latency = _LatencyTracker()
         self._draining = threading.Event()
         self.request_timeout_s = request_timeout_s
         self.screen_max_pairs = int(screen_max_pairs)
+        # Requests without their own X-Request-Deadline-Ms / deadline_s
+        # get this budget; <= 0 keeps the legacy no-deadline behavior
+        # (request_timeout_s is then the only bound).
+        self.default_deadline_ms = float(default_deadline_ms)
+        # Degraded-mode switch over the same signals /metrics serves:
+        # admission utilization + queue depth, request p99, compile
+        # in-flight (serving/admission.py). Evaluated per POST and per
+        # /healthz — no background thread.
+        self.shedder = LoadShedder(shedder_cfg or ShedderConfig(),
+                                   self._shed_signals)
         # Screens share one embedding cache across requests (a library
         # chain re-screened later skips its encoder pass) and serialize
         # on one lock: each screen is many device dispatches, and two
@@ -193,8 +223,45 @@ class ServingServer:
                 return parse_qs(query).get("trace", ["0"])[-1] in (
                     "1", "true", "yes")
 
+            def _request_deadline(self, payload: Optional[Dict] = None):
+                """Per-request deadline: the ``X-Request-Deadline-Ms``
+                header wins, then a JSON body's ``deadline_s``, then the
+                server-wide default; None = no deadline (legacy
+                behavior, request_timeout_s is the only bound). Raises
+                ValueError on a non-positive or non-numeric budget."""
+                hdr = self.headers.get("X-Request-Deadline-Ms")
+                if hdr is not None:
+                    ms = float(hdr)
+                    if not ms > 0:
+                        raise ValueError(
+                            f"X-Request-Deadline-Ms must be > 0, got {hdr!r}")
+                    return Deadline.after(ms / 1e3)
+                if payload is not None and "deadline_s" in payload:
+                    sec = float(payload["deadline_s"])
+                    if not sec > 0:
+                        raise ValueError(
+                            f"deadline_s must be > 0, got {sec!r}")
+                    return Deadline.after(sec)
+                if server.default_deadline_ms > 0:
+                    return Deadline.after(server.default_deadline_ms / 1e3)
+                return None
+
+            def _send_overloaded(self, retry_after_s: float,
+                                 error: str) -> None:
+                """429 + Retry-After: the client retry contract for both
+                admission rejections and shedder-degraded mode."""
+                import math
+
+                retry = max(1, int(math.ceil(retry_after_s)))
+                self._send_json(
+                    429,
+                    {"error": error,
+                     "retry_after_s": round(float(retry_after_s), 3)},
+                    extra_headers={"Retry-After": str(retry)})
+
             def _send_body(self, code: int, body: bytes,
-                           content_type: str) -> None:
+                           content_type: str,
+                           extra_headers: Optional[Dict] = None) -> None:
                 # Counted BEFORE the body write: a client that disconnects
                 # mid-response must not make the request vanish from the
                 # counter while the latency histogram already saw it (the
@@ -209,20 +276,33 @@ class ServingServer:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (extra_headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _send_json(self, code: int, payload: Dict) -> None:
+            def _send_json(self, code: int, payload: Dict,
+                           extra_headers: Optional[Dict] = None) -> None:
                 self._send_body(code, json.dumps(payload).encode(),
-                                "application/json")
+                                "application/json",
+                                extra_headers=extra_headers)
 
             def do_GET(self):  # noqa: N802 - stdlib name
                 route = self._route()
                 if route == "/healthz":
+                    # Degraded (overloaded) is a liveness-page state, not
+                    # an error: the process is healthy, it is REFUSING
+                    # work on purpose. /stats and /metrics stay live
+                    # throughout — observability during the incident is
+                    # the point.
+                    degraded = server.shedder.evaluate()
+                    draining = server._draining.is_set()
+                    status = ("draining" if draining
+                              else "overloaded" if degraded else "ok")
                     self._send_json(200, {
-                        "status": "draining" if server._draining.is_set()
-                        else "ok",
-                        "draining": server._draining.is_set(),
+                        "status": status,
+                        "draining": draining,
+                        "degraded": degraded,
                     })
                 elif route == "/stats":
                     self._send_json(200, server.stats())
@@ -240,6 +320,17 @@ class ServingServer:
                 if server._draining.is_set():
                     self._send_json(503, {"error": "server is draining"})
                     return
+                if server.shedder.evaluate():
+                    # Degraded mode: drain the body (keep-alive framing
+                    # must stay intact) but skip ALL parse/featurize work.
+                    self.rfile.read(int(self.headers.get(
+                        "Content-Length", 0)))
+                    server.shedder.count_rejection()
+                    self._send_overloaded(
+                        server.engine.admission.retry_after_s(),
+                        "server overloaded (load shedding active); "
+                        "retry after the indicated delay")
+                    return
                 if route == "/screen":
                     self._do_screen()
                     return
@@ -248,8 +339,11 @@ class ServingServer:
                     body = self.rfile.read(length)
                     ctype = self.headers.get("Content-Type", "")
                     if ctype.startswith("application/json"):
-                        raw = raw_from_json(json.loads(body.decode()))
+                        payload = json.loads(body.decode())
+                        deadline = self._request_deadline(payload)
+                        raw = raw_from_json(payload)
                     else:
+                        deadline = self._request_deadline()
                         raw = raw_from_npz_bytes(body)
                 except Exception as exc:  # noqa: BLE001 - client error
                     self._send_json(400, {"error": str(exc)})
@@ -262,8 +356,18 @@ class ServingServer:
                 try:
                     result = server.engine.predict(
                         raw, timeout=server.request_timeout_s,
-                        reqtrace=reqtrace)
-                except SchedulerClosed:
+                        reqtrace=reqtrace, deadline=deadline)
+                except Overloaded as exc:
+                    self._send_overloaded(exc.retry_after_s, str(exc))
+                    return
+                except DeadlineExceeded as exc:
+                    response = {"error": str(exc),
+                                "trace_id": reqtrace.trace_id}
+                    if self._trace_requested() and exc.trace is not None:
+                        response["trace"] = exc.trace
+                    self._send_json(504, response)
+                    return
+                except (SchedulerClosed, ShuttingDown):
                     self._send_json(503, {"error": "server is draining"})
                     return
                 except Exception as exc:  # noqa: BLE001 - surfaced to client
@@ -294,6 +398,7 @@ class ServingServer:
                     payload = json.loads(self.rfile.read(length).decode())
                     if not isinstance(payload, dict):
                         raise ValueError("screen body must be a JSON object")
+                    deadline = self._request_deadline(payload)
                 except Exception as exc:  # noqa: BLE001 - client error
                     self._send_json(400, {"error": str(exc)})
                     return
@@ -301,7 +406,12 @@ class ServingServer:
                 t0 = time.monotonic()
                 try:
                     out = server.run_screen(payload,
-                                            trace_id=reqtrace.trace_id)
+                                            trace_id=reqtrace.trace_id,
+                                            deadline=deadline)
+                except DeadlineExceeded as exc:
+                    self._send_json(504, {"error": str(exc),
+                                          "trace_id": reqtrace.trace_id})
+                    return
                 except (ValueError, KeyError, FileNotFoundError,
                         OSError) as exc:
                     self._send_json(400, {"error": str(exc)})
@@ -382,11 +492,14 @@ class ServingServer:
 
     # -- screening ---------------------------------------------------------
 
-    def run_screen(self, payload: Dict, trace_id: str = "") -> Dict:
+    def run_screen(self, payload: Dict, trace_id: str = "",
+                   deadline: Optional[Deadline] = None) -> Dict:
         """Synchronous small screen for ``POST /screen`` (see module
         docstring). Raises ValueError/KeyError/OSError for client
         mistakes (mapped to 400 by the handler). ``trace_id`` labels the
-        screen's ``screen_encode``/``screen_decode`` span events."""
+        screen's ``screen_encode``/``screen_decode`` span events.
+        ``deadline`` is enforced at encode/decode batch boundaries
+        (DeadlineExceeded -> 504)."""
         from deepinteract_tpu.screening import (
             ChainLibrary,
             EmbeddingCache,
@@ -420,7 +533,8 @@ class ServingServer:
                     top_k=int(payload.get("top_k", 10)),
                     decode_batch=self.engine.cfg.max_batch,
                     encode_batch=self.engine.cfg.max_batch))
-            result = runner.screen(library, pairs, trace_id=trace_id)
+            result = runner.screen(library, pairs, trace_id=trace_id,
+                                   deadline=deadline)
         return {
             "chains": result.chains,
             "pairs": result.pairs_total,
@@ -430,11 +544,28 @@ class ServingServer:
 
     # -- observability -----------------------------------------------------
 
+    def _shed_signals(self) -> Dict[str, float]:
+        """The load shedder's inputs, read from the SAME sources /metrics
+        serves: admission occupancy (leading indicator), the request-
+        latency histogram's p99, and the compile-in-flight gauge."""
+        adm = self.engine.admission.stats()
+        return {
+            "utilization": adm["inflight"] / max(1, adm["max_inflight"]),
+            "queue_depth": float(adm["queued"]),
+            "p99_ms": float(self.latency.stats().get("p99_ms", 0.0)),
+            "compile_inflight": obs_metrics.gauge(
+                "di_serving_compile_inflight").value(),
+        }
+
     def stats(self) -> Dict[str, Any]:
+        # /stats stays live in degraded mode BY DESIGN (the shedder only
+        # gates POST routes): an overloaded server that also goes blind
+        # is an unoperable one.
         return {
             "engine": self.engine.stats(),
             "latency": self.latency.stats(),
             "screening": self.screening_stats(),
+            "shedding": self.shedder.stats(),
             "draining": self._draining.is_set(),
         }
 
@@ -481,6 +612,15 @@ class ServingServer:
         g("di_serving_draining",
           "1 while the server refuses new work").set(
             float(self._draining.is_set()))
+        # Refresh the shedder at scrape time: di_shed_degraded must show
+        # the CURRENT mode even when no request has polled it recently.
+        self.shedder.evaluate()
+        adm = eng["admission"]
+        g("di_serving_inflight",
+          "Admitted requests not yet answered").set(adm["inflight"])
+        g("di_serving_retry_after_seconds",
+          "Current backlog-drain estimate handed to rejected clients").set(
+            adm["retry_after_s"])
         screening = self.screening_stats()
         g("di_serving_screen_emb_cache_entries",
           "Embeddings resident in the shared /screen cache").set(

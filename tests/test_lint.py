@@ -60,6 +60,28 @@ def test_repo_wide_lint_passes_against_baseline(capsys):
     assert rec["files_scanned"] > 100
 
 
+def test_serving_overload_layer_is_lock_discipline_clean():
+    """ISSUE-11 satellite: the serving resilience layer's lock-guarded
+    admission/shedder/scheduler state (serving/admission.py + the
+    reworked scheduler/engine/server) introduces ZERO lock-discipline
+    findings — active OR newly suppressed beyond the engine's two
+    long-standing trace-count pragmas — so the PR-8 baseline stays
+    empty on the layer where the races would actually bite."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    files = [f for f in load_files(repo)
+             if f.path.startswith("deepinteract_tpu/serving/")]
+    assert {"deepinteract_tpu/serving/admission.py",
+            "deepinteract_tpu/serving/scheduler.py"} <= {
+                f.path for f in files}
+    r = findings_of(repo, "lock-discipline", files=files)
+    assert [(f.path, f.line) for f in r.findings] == []
+    # The only suppressions across serving/ predate this layer (engine
+    # trace-count increments under _exec_lock via _compiled, server's
+    # deliberate lock-free screening_stats read).
+    assert all("admission" not in f.path and "scheduler" not in f.path
+               for f in r.suppressed)
+
+
 def test_repo_wide_suppressions_are_intentional(capsys):
     """Every suppressed finding in the repo carries a pragma some human
     wrote next to real code; the count is pinned so a silently growing

@@ -73,6 +73,62 @@ TINY_FLAGS = [
 ]
 
 
+def _run_two_procs(tmp_path, root, tag, extra_flags=(), extra_env=None,
+                   num_epochs=1, timeout=1500):
+    """Launch one coordinated 2-process cli.train run; returns the two
+    stdout captures. Workdirs are ``<tmp>/<tag>_host{0,1}`` (stable per
+    tag so a rerun with --resume finds its checkpoints)."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        workdir = tmp_path / f"{tag}_host{pid}"
+        workdir.mkdir(exist_ok=True)
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            JAX_TRACEBACK_FILTERING="off",
+        )
+        env.update(extra_env or {})
+        cmd = [
+            sys.executable, "-m", "deepinteract_tpu.cli.train",
+            "--dips_root", str(root),
+            "--ckpt_dir", str(workdir / "ckpt"),
+            "--coordinator_address", f"127.0.0.1:{port}",
+            "--num_processes", "2", "--process_id", str(pid),
+        ] + TINY_FLAGS + [
+            # argparse keeps the LAST occurrence: override TINY_FLAGS'
+            # --num_epochs 1 without editing the shared list.
+            "--num_epochs", str(num_epochs),
+        ] + list(extra_flags)
+        procs.append(
+            subprocess.Popen(cmd, cwd=str(workdir), env=env,
+                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                             text=True)
+        )
+    outs = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"{tag} process {pid} timed out; partial output:\n"
+                        f"{proc.communicate()[0][-4000:]}")
+        outs.append(out)
+        assert proc.returncode == 0, (
+            f"{tag} process {pid} failed:\n{out[-6000:]}")
+    return outs
+
+
+def _epoch_line(out: str, epoch: int) -> str:
+    """The per-epoch metrics line with host-local wall clocks stripped
+    (train_s=/val_s= legitimately differ across processes and runs)."""
+    lines = [l for l in out.splitlines() if l.startswith(f"epoch {epoch}:")]
+    assert lines, f"no 'epoch {epoch}:' line in:\n{out[-2000:]}"
+    return re.sub(r" (?:train|val)_s=[0-9.]+", "", lines[-1])
+
+
 @pytest.mark.slow
 def test_two_process_cli_train(tmp_path):
     root = tmp_path / "data"
@@ -134,7 +190,77 @@ def test_two_process_cli_train(tmp_path):
     assert epoch_line(outs[0]) == epoch_line(outs[1])
 
     # Rank-0 gating: primary wrote checkpoint + CSV, secondary neither.
+    # (host1 may hold an EMPTY per-host XLA compile_cache dir under
+    # ckpt/ — PR-4's jit cache is per-process by design; the rank-0-only
+    # property is about checkpoint TREES and CSVs.)
     assert (tmp_path / "host0" / "ckpt" / "best").is_dir()
     assert (tmp_path / "host0" / "test_top_metrics.csv").exists()
-    assert not (tmp_path / "host1" / "ckpt").exists()
+    assert not (tmp_path / "host1" / "ckpt" / "last").exists()
+    assert not (tmp_path / "host1" / "ckpt" / "best").exists()
     assert not (tmp_path / "host1" / "test_top_metrics.csv").exists()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_two_process_kill_after_save_resume_parity(tmp_path):
+    """ROADMAP item 4 chaos satellite: a coordinated 2-host run killed by
+    an injected SIGTERM that lands right AFTER an epoch's checkpoint
+    flush (the PR-1 ``train.sigterm`` fault site; multi-host saves are
+    synchronous BY DESIGN — ``training/loop.py`` downgrades the async
+    snapshot path when ``process_count > 1``, so 'kill after save' is
+    the pod-scale analog of the single-host kill-after-async-save) must
+    leave a resumable state: rerunning with ``--resume`` reproduces the
+    uninterrupted run's epoch metrics EXACTLY on both hosts, and
+    checkpoint/CSV artifacts stay rank-0-only throughout.
+
+    Fault placement: ``@3`` = each host's first train batch of epoch 1
+    sets the flag; multi-host raises ONLY at epoch boundaries (the
+    all-gather agreement in ``_check_preempt``, so both hosts stop
+    together instead of stranding a peer in a collective) — the run
+    therefore finishes + SAVES epoch 1, then exits 0 at the epoch-2
+    boundary. That is exactly the kill-after-save window."""
+    root = tmp_path / "data"
+    _build_tiny_dataset(str(root))
+
+    # Reference: the uninterrupted 3-epoch run.
+    ref_outs = _run_two_procs(tmp_path, root, "ref", num_epochs=3)
+    ref_ep2 = [_epoch_line(out, 2) for out in ref_outs]
+    assert ref_ep2[0] == ref_ep2[1]  # replicated training agrees
+
+    chaos_outs = _run_two_procs(
+        tmp_path, root, "chaos", num_epochs=3,
+        extra_env={"DI_FAULTS": "train.sigterm=@3"})
+    for out in chaos_outs:
+        _epoch_line(out, 1)  # epoch 1 completed, logged (and saved)
+        assert not [l for l in out.splitlines()
+                    if l.startswith("epoch 2:")], (
+            "preemption should have stopped epoch 2:\n" + out[-2000:])
+        assert "preemption: injected SIGTERM" in out
+    # The interrupted state is durable and rank-0-only (host1's empty
+    # per-host XLA compile_cache dir is allowed — see the 1-proc test).
+    assert (tmp_path / "chaos_host0" / "ckpt" / "last").is_dir()
+    assert not (tmp_path / "chaos_host1" / "ckpt" / "last").exists()
+    assert not (tmp_path / "chaos_host1" / "ckpt" / "best").exists()
+
+    # Resume: same workdirs, no fault plan, --resume. Epoch 2 must match
+    # the uninterrupted run bit-for-bit (metrics line equality, host
+    # wall clocks stripped) on BOTH hosts — state parity across the
+    # kill/resume cycle.
+    resume_outs = _run_two_procs(
+        tmp_path, root, "chaos", num_epochs=3, extra_flags=("--resume",))
+    # "resumed from epoch N" is logged by the host holding the
+    # Checkpointer (rank-0); peers receive epoch + state by broadcast.
+    assert "resumed from epoch 2" in resume_outs[0], resume_outs[0][-2000:]
+    for pid, out in enumerate(resume_outs):
+        # Every host trained ONLY the missing epoch 2...
+        assert not [l for l in out.splitlines()
+                    if l.startswith(("epoch 0:", "epoch 1:"))], out[-2000:]
+        # ...and reproduced the uninterrupted run's metrics exactly.
+        assert _epoch_line(out, 2) == ref_ep2[pid]
+
+    # Rank-0-only artifacts after the full interrupted->resumed cycle.
+    assert (tmp_path / "chaos_host0" / "ckpt" / "best").is_dir()
+    assert (tmp_path / "chaos_host0" / "test_top_metrics.csv").exists()
+    assert not (tmp_path / "chaos_host1" / "ckpt" / "last").exists()
+    assert not (tmp_path / "chaos_host1" / "ckpt" / "best").exists()
+    assert not (tmp_path / "chaos_host1" / "test_top_metrics.csv").exists()

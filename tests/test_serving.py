@@ -23,13 +23,22 @@ from deepinteract_tpu.data.io import save_complex_npz
 from deepinteract_tpu.models.decoder import DecoderConfig
 from deepinteract_tpu.models.geometric_transformer import GTConfig
 from deepinteract_tpu.models.model import ModelConfig
+from deepinteract_tpu.robustness import faults
 from deepinteract_tpu.robustness.preemption import PreemptionGuard
 from deepinteract_tpu.serving import (
+    AdmissionController,
+    BatchExecutionError,
+    Deadline,
+    DeadlineExceeded,
     EngineConfig,
     InferenceEngine,
+    LoadShedder,
     MicroBatchScheduler,
+    Overloaded,
     ResultCache,
     SchedulerClosed,
+    ShedderConfig,
+    ShuttingDown,
     ServingServer,
     content_hash,
 )
@@ -92,7 +101,10 @@ def engine(tuning_store_path):
 
 @pytest.fixture(scope="module")
 def server(engine):
-    srv = ServingServer(engine, port=0)
+    # Short shedder dwell so the degraded-mode test can watch a full
+    # enter -> exit cycle without sleeping the suite.
+    srv = ServingServer(engine, port=0,
+                        shedder_cfg=ShedderConfig(min_degraded_s=0.05))
     guard = PreemptionGuard(log=lambda s: None)  # flag-only off main thread
     rc = {}
     thread = threading.Thread(
@@ -197,6 +209,218 @@ def test_scheduler_flush_error_fails_the_whole_group():
                 f.result(timeout=5)
     finally:
         sched.drain()
+
+
+# ---------------------------------------------------------------------------
+# admission.py units (no jax, no compiles)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_controller_bounds_and_retry_after():
+    adm = AdmissionController(max_queue_depth=2, max_inflight=3)
+    adm.try_admit("k")
+    adm.try_admit("k")
+    # Per-bucket queue bound hit: typed rejection with a retry hint.
+    with pytest.raises(Overloaded) as exc:
+        adm.try_admit("k")
+    assert exc.value.retry_after_s > 0
+    # A different bucket still has queue room, but the GLOBAL in-flight
+    # cap (3) trips next.
+    adm.try_admit("k2")
+    with pytest.raises(Overloaded):
+        adm.try_admit("k3")
+    s = adm.stats()
+    assert s["inflight"] == 3 and s["queued"] == 3
+    assert s["rejected_queue_full"] == 1 and s["rejected_inflight_full"] == 1
+    # Dequeue moves work out of the queue but not out of flight; done
+    # frees capacity for new admissions.
+    adm.on_dequeue("k", 2)
+    assert adm.stats()["queued"] == 1 and adm.stats()["inflight"] == 3
+    adm.on_done(2)
+    adm.try_admit("k")  # admits again
+    # Retry-after tracks backlog over the observed service rate.
+    adm.observe_batch(8, 1.0)  # 8 rps
+    assert adm.stats()["service_rate_rps"] > 0
+    assert 0.1 <= adm.retry_after_s() <= 60.0
+    # cancel() undoes an admit that never enqueued.
+    before = adm.stats()["inflight"]
+    adm.try_admit("z")
+    adm.cancel("z")
+    assert adm.stats()["inflight"] == before
+
+
+def test_deadline_expiry_and_remaining():
+    dl = Deadline.after(60.0)
+    assert not dl.expired and 59.0 < dl.remaining_s() <= 60.0
+    gone = Deadline.after(-0.001)
+    assert gone.expired and gone.remaining_s() == 0.0
+
+
+def test_load_shedder_hysteresis_enters_and_exits():
+    sig = {"utilization": 0.0, "queue_depth": 0.0, "p99_ms": 0.0,
+           "compile_inflight": 0.0}
+    clock = {"t": 100.0}
+    shed = LoadShedder(
+        ShedderConfig(enter_utilization=0.9, exit_utilization=0.5,
+                      min_degraded_s=2.0),
+        signals_fn=lambda: dict(sig), now_fn=lambda: clock["t"])
+    assert shed.evaluate() is False
+    # Over the enter threshold -> degraded.
+    sig["utilization"] = 0.95
+    assert shed.evaluate() is True
+    # Dropping below EXIT is not enough before the dwell passes...
+    sig["utilization"] = 0.1
+    clock["t"] += 1.0
+    assert shed.evaluate() is True
+    # ...and a load between exit and enter never recovers (hysteresis).
+    sig["utilization"] = 0.7
+    clock["t"] += 5.0
+    assert shed.evaluate() is True
+    # Below exit after the dwell -> healthy again.
+    sig["utilization"] = 0.2
+    assert shed.evaluate() is False
+    s = shed.stats()
+    assert s["transitions"] == 2 and s["degraded"] is False
+    # Compile-stall trigger: a cold compile in flight degrades as soon
+    # as utilization is past the EXIT threshold (flushes stall behind
+    # the exec lock) — but an idle warmup compile does not.
+    sig.update(utilization=0.6, compile_inflight=1.0)
+    clock["t"] += 10.0
+    assert shed.evaluate() is True
+    assert "compile" in shed.stats()["reason"]
+    sig.update(utilization=0.0, compile_inflight=1.0)
+    clock["t"] += 10.0
+    assert shed.evaluate() is False  # idle + compiling recovers
+    # Queue-depth trigger (opt-in via enter_queue_depth).
+    qshed = LoadShedder(
+        ShedderConfig(enter_queue_depth=10, min_degraded_s=0.0),
+        signals_fn=lambda: {"utilization": 0.0, "queue_depth": 12.0},
+        now_fn=lambda: clock["t"])
+    assert qshed.evaluate() is True
+    assert "queue depth" in qshed.stats()["reason"]
+    # Disabled shedder never degrades.
+    off = LoadShedder(ShedderConfig(enabled=False),
+                      signals_fn=lambda: {"utilization": 1.0})
+    assert off.evaluate() is False
+
+
+def test_scheduler_bounded_queue_rejects_typed_overloaded():
+    """ISSUE-11 acceptance (unit half): with an admission controller
+    attached, submits beyond the per-bucket bound fail AT SUBMIT TIME
+    with a typed Overloaded + retry_after_s — accepted work still
+    completes untouched."""
+    gate = threading.Event()
+
+    def flush(key, payloads):
+        gate.wait(10)
+        return list(payloads)
+
+    adm = AdmissionController(max_queue_depth=2, max_inflight=64)
+    sched = MicroBatchScheduler(flush, max_batch=2, max_delay_ms=1.0,
+                                admission=adm)
+    try:
+        accepted = [sched.submit("k", 0), sched.submit("k", 1)]
+        time.sleep(0.1)  # worker dequeues the full batch, blocks in flush
+        accepted += [sched.submit("k", 2), sched.submit("k", 3)]
+        rejected = 0
+        for i in range(4, 8):
+            try:
+                accepted.append(sched.submit("k", i))
+            except Overloaded as exc:
+                assert exc.retry_after_s > 0
+                rejected += 1
+        assert rejected >= 2  # queue bound held while the worker was busy
+        gate.set()
+        assert sorted(f.result(timeout=10) for f in accepted) == sorted(
+            range(len(accepted)))
+        assert adm.stats()["inflight"] == 0  # all capacity released
+    finally:
+        gate.set()
+        sched.drain()
+
+
+def test_scheduler_deadline_sweep_drops_before_batch_assembly():
+    """An expired-deadline request is failed with DeadlineExceeded and
+    NEVER reaches the flush fn (no padded batch slot, no dispatch)."""
+    gate = threading.Event()
+    flushed = []
+
+    def flush(key, payloads):
+        gate.wait(10)
+        flushed.append(list(payloads))
+        return list(payloads)
+
+    sched = MicroBatchScheduler(flush, max_batch=1, max_delay_ms=0.0)
+    try:
+        f_live = sched.submit("k", "live")  # occupies the worker
+        time.sleep(0.05)
+        f_dead = sched.submit("k", "doomed", deadline=Deadline.after(0.05))
+        time.sleep(0.2)  # deadline passes while the worker is busy
+        gate.set()
+        assert f_live.result(timeout=10) == "live"
+        with pytest.raises(DeadlineExceeded, match="queued"):
+            f_dead.result(timeout=10)
+        assert all("doomed" not in group for group in flushed)
+        assert sched.stats()["deadline_expired"] == 1
+    finally:
+        gate.set()
+        sched.drain()
+
+
+def test_scheduler_worker_survives_poisoned_group():
+    """Satellite regression: a flush failure fails ONLY its group (typed,
+    counted on di_serving_batch_failures_total) and the worker thread
+    keeps serving subsequent requests instead of dying silently."""
+    from deepinteract_tpu.obs import metrics as obs_metrics
+
+    calls = {"n": 0}
+
+    def flush(key, payloads):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise BatchExecutionError("injected poison", stage="dispatch")
+        return list(payloads)
+
+    counter = obs_metrics.counter("di_serving_batch_failures_total")
+    before = counter.value()
+    sched = MicroBatchScheduler(flush, max_batch=1, max_delay_ms=0.0)
+    try:
+        poisoned = sched.submit("k", 1)
+        with pytest.raises(BatchExecutionError, match="poison"):
+            poisoned.result(timeout=5)
+        # The worker survived: the NEXT request is served normally.
+        assert sched.submit("k", 2).result(timeout=5) == 2
+        assert sched.stats()["batch_failures"] == 1
+        assert counter.value() == before + 1
+    finally:
+        sched.drain()
+
+
+def test_scheduler_drain_timeout_fails_queued_with_shutting_down():
+    """Satellite: a drain that times out with work still queued answers
+    every queued future with a typed ShuttingDown instead of leaving
+    clients hanging on .result() after the process exits."""
+    gate = threading.Event()
+
+    def flush(key, payloads):
+        gate.wait(30)
+        return list(payloads)
+
+    adm = AdmissionController(max_queue_depth=8, max_inflight=8)
+    sched = MicroBatchScheduler(flush, max_batch=1, max_delay_ms=0.0,
+                                admission=adm)
+    try:
+        stuck = sched.submit("k", 1)  # the worker blocks flushing this
+        time.sleep(0.05)
+        queued = sched.submit("k", 2)  # still in the pending queue
+        assert sched.drain(timeout=0.3) is False
+        with pytest.raises(ShuttingDown):
+            queued.result(timeout=5)
+        # The queued request's admission slot was released too.
+        assert adm.stats()["queued"] == 0
+        assert not stuck.done()  # in-flight group left pending (honest)
+    finally:
+        gate.set()
 
 
 # ---------------------------------------------------------------------------
@@ -336,6 +560,184 @@ def test_batch_slots_inventory_is_power_of_two_capped(engine):
     assert engine.normalize_warmup(128, 128, 6) == (128, 128, 8)
     assert engine.normalize_warmup(300, 300, 2) == (512, 512, 2)
     assert engine.normalize_warmup(64, 64, 99) == (64, 64, 8)
+
+
+# ---------------------------------------------------------------------------
+# overload / deadline / chaos suite (ISSUE-11) — engine level
+# ---------------------------------------------------------------------------
+
+
+def test_engine_expired_deadline_never_reaches_dispatch(engine):
+    """ISSUE-11 acceptance: expired-deadline requests are failed with
+    DeadlineExceeded BEFORE device dispatch — asserted via the dispatch
+    counters (executed_requests unchanged) AND the trace decomposition
+    attached to the failure (device_ms == 0)."""
+    from deepinteract_tpu.obs import metrics as obs_metrics
+    from deepinteract_tpu.obs.reqtrace import RequestTrace
+
+    expired_total = obs_metrics.counter(
+        "di_admission_deadline_expired_total", labelnames=("where",))
+    # Dead on arrival -> rejected at admission, no future minted.
+    before_adm = expired_total.value(where="admission")
+    with pytest.raises(DeadlineExceeded, match="admission"):
+        engine.submit(fresh_raw(700), deadline=Deadline.after(-0.01))
+    assert expired_total.value(where="admission") == before_adm + 1
+
+    # Expiry while QUEUED: stall the flush worker by holding the exec
+    # lock (the executable lookup in _flush blocks on it), queue a
+    # short-deadline request behind a live one, and release after the
+    # deadline passes.
+    engine.warmup([(64, 64, 1)], knn=KNN, geo=GEO)
+    executed_before = engine.stats()["executed_requests"]
+    before_queue = expired_total.value(where="queue")
+    engine._exec_lock.acquire()
+    try:
+        f_live = engine.submit(fresh_raw(701))
+        time.sleep(0.05)  # worker dequeues 701, blocks in _flush
+        f_dead = engine.submit(fresh_raw(702),
+                               reqtrace=RequestTrace("/predict"),
+                               deadline=Deadline.after(0.08))
+        time.sleep(0.3)
+    finally:
+        engine._exec_lock.release()
+    assert f_live.result(timeout=120)["probs"].shape == (20, 16)
+    with pytest.raises(DeadlineExceeded) as exc:
+        f_dead.result(timeout=30)
+    trace = exc.value.trace
+    assert trace is not None and trace["device_ms"] == 0.0
+    assert trace["deadline_ms"] == pytest.approx(80.0)
+    assert trace["queue_wait_ms"] > 0
+    assert expired_total.value(where="queue") == before_queue + 1
+    # Only the live request burned a dispatch.
+    assert engine.stats()["executed_requests"] == executed_before + 1
+    # A result arriving WITHIN deadline reports its budget in the trace.
+    ok = engine.predict(fresh_raw(703), reqtrace=RequestTrace("/predict"),
+                        deadline=Deadline.after(60.0))
+    assert ok["trace"]["deadline_ms"] == pytest.approx(60_000.0)
+    assert 0 < ok["trace"]["deadline_remaining_ms"] <= 60_000.0
+
+
+def test_engine_bounded_queue_rejects_with_retry_after(engine):
+    """ISSUE-11 acceptance: beyond the admission bounds, submits raise a
+    typed Overloaded carrying retry_after_s; every ACCEPTED request is
+    still served once capacity frees."""
+    adm = engine.admission
+    saved = adm.max_queue_depth
+    engine._exec_lock.acquire()
+    accepted, rejects = [], []
+    try:
+        adm.max_queue_depth = 2
+        accepted.append(engine.submit(fresh_raw(710)))
+        time.sleep(0.05)  # worker dequeues it, stalls on the exec lock
+        for i in range(5):
+            try:
+                accepted.append(engine.submit(fresh_raw(711 + i)))
+            except Overloaded as exc:
+                rejects.append(exc)
+    finally:
+        adm.max_queue_depth = saved
+        engine._exec_lock.release()
+    assert len(rejects) >= 2, "bounded queue failed to reject excess load"
+    assert all(r.retry_after_s > 0 for r in rejects)
+    for fut in accepted:
+        assert fut.result(timeout=120)["probs"].shape == (20, 16)
+    s = engine.stats()["admission"]
+    assert s["rejected_queue_full"] >= len(rejects)
+    assert s["inflight"] == 0
+
+
+def test_engine_overload_burst_resolves_every_future(engine):
+    """Mini saturation (the bench `saturation` section scaled to tier-1):
+    a concurrent burst over tightened bounds — every submit either
+    serves, rejects typed at admission, or fails its deadline; nothing
+    hangs past the deadline bound."""
+    adm = engine.admission
+    saved = (adm.max_queue_depth, adm.max_inflight)
+    outcomes = {"served": 0, "rejected": 0, "deadline": 0}
+    lock = threading.Lock()
+
+    def client(seed):
+        try:
+            out = engine.predict(fresh_raw(seed),
+                                 deadline=Deadline.after(30.0))
+            with lock:
+                outcomes["served"] += 1
+            assert out["probs"].shape == (20, 16)
+        except Overloaded:
+            with lock:
+                outcomes["rejected"] += 1
+        except DeadlineExceeded:
+            with lock:
+                outcomes["deadline"] += 1
+
+    try:
+        adm.max_queue_depth, adm.max_inflight = 3, 6
+        threads = [threading.Thread(target=client, args=(720 + i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "a client hung"
+    finally:
+        adm.max_queue_depth, adm.max_inflight = saved
+    assert sum(outcomes.values()) == 16
+    assert outcomes["served"] >= 1
+    assert outcomes["rejected"] >= 1, outcomes
+    assert engine.stats()["admission"]["inflight"] == 0
+
+
+@pytest.mark.chaos
+def test_chaos_injected_dispatch_fault_fails_only_its_batch(engine):
+    """ISSUE-11 acceptance: a chaos-injected device-dispatch fault fails
+    only that batch's futures with a typed BatchExecutionError — the
+    scheduler worker survives and the engine keeps serving."""
+    from deepinteract_tpu.obs import metrics as obs_metrics
+
+    injected = obs_metrics.counter("di_faults_injected_total",
+                                   labelnames=("site",))
+    failures = obs_metrics.counter("di_serving_batch_failures_total")
+    fail_before = failures.value()
+    inj_before = injected.value(site="serving.dispatch")
+    faults.configure({"serving.dispatch": [1]})
+    try:
+        # Seeds 760+ are unique to this test: a seed the burst test above
+        # may have cached would short-circuit before _flush and the
+        # injected fault would never fire.
+        with pytest.raises(BatchExecutionError) as exc:
+            engine.predict(fresh_raw(760))
+        assert exc.value.stage == "dispatch"
+        assert injected.value(site="serving.dispatch") == inj_before + 1
+        assert failures.value() == fail_before + 1
+        # The engine keeps serving: same bucket, next request, no new
+        # worker, no wedge.
+        out = engine.predict(fresh_raw(761))
+        assert out["probs"].shape == (20, 16)
+    finally:
+        faults.reset()
+
+
+@pytest.mark.chaos
+def test_chaos_assembly_and_admission_faults_are_typed(engine):
+    """The other two serving fault sites: batch assembly fails its group
+    typed (worker survives), and an admission fault surfaces as
+    Overloaded with a retry hint — the full injectable surface of the
+    request path."""
+    faults.configure({"serving.assembly": [1]})
+    try:
+        with pytest.raises(BatchExecutionError) as exc:
+            engine.predict(fresh_raw(770))
+        assert exc.value.stage == "assembly"
+    finally:
+        faults.reset()
+    faults.configure({"serving.admission": [1]})
+    try:
+        with pytest.raises(Overloaded) as exc:
+            engine.predict(fresh_raw(771))
+        assert exc.value.retry_after_s > 0
+    finally:
+        faults.reset()
+    assert engine.predict(fresh_raw(772))["probs"].shape == (20, 16)
 
 
 # ---------------------------------------------------------------------------
@@ -553,6 +955,132 @@ def test_request_histograms_in_metrics(server):
         count = samples[(f"{family}_count",
                          frozenset([("route", "/predict")]))]
         assert count >= 1, family
+
+
+def test_http_deadline_header_expired_maps_to_504(server):
+    """An already-hopeless client deadline answers 504 (typed
+    DeadlineExceeded) without burning a device dispatch; a malformed
+    header is a 400 client error."""
+    srv, _, _, _ = server
+    host, port = srv.address
+    executed_before = srv.engine.stats()["executed_requests"]
+    raw = fresh_raw(800)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "c.npz")
+        save_complex_npz(path, raw["graph1"], raw["graph2"],
+                         raw["examples"], "c")
+        with open(path, "rb") as fh:
+            body = fh.read()
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("POST", "/predict", body=body,
+                     headers={"Content-Type": "application/octet-stream",
+                              "X-Request-Deadline-Ms": "0.0001"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 504
+        assert "deadline" in out["error"].lower()
+        assert len(out["trace_id"]) == 16
+    finally:
+        conn.close()
+    assert srv.engine.stats()["executed_requests"] == executed_before
+    # Malformed budget -> 400, not a 500.
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("POST", "/predict", body=body,
+                     headers={"Content-Type": "application/octet-stream",
+                              "X-Request-Deadline-Ms": "-5"})
+        assert conn.getresponse().status == 400
+    finally:
+        conn.close()
+    # A generous deadline serves normally and reports its budget in the
+    # ?trace=1 decomposition.
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    try:
+        conn.request("POST", "/predict?trace=1", body=body,
+                     headers={"Content-Type": "application/octet-stream",
+                              "X-Request-Deadline-Ms": "60000"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200
+        assert out["trace"]["deadline_ms"] == pytest.approx(60_000.0)
+    finally:
+        conn.close()
+
+
+def test_http_screen_deadline_maps_to_504(server, tmp_path):
+    """POST /screen is deadline-aware: an expired budget stops the
+    screen at a batch boundary and answers 504."""
+    srv, _, _, _ = server
+    host, port = srv.address
+    raw = fresh_raw(810)
+    path = str(tmp_path / "c.npz")
+    save_complex_npz(path, raw["graph1"], raw["graph2"], raw["examples"],
+                     "c")
+    body = json.dumps({"npz_paths": [path], "deadline_s": 1e-6}).encode()
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        conn.request("POST", "/screen", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 504
+        assert "deadline" in out["error"].lower()
+    finally:
+        conn.close()
+
+
+def test_http_shedder_degrades_and_recovers(server):
+    """ISSUE-11 acceptance: under (synthetic) overload signals the
+    shedder flips the server degraded — POST answers 429 + Retry-After,
+    /healthz reports overloaded — while /stats and /metrics stay live;
+    when the signals recover (and the hysteresis dwell passes) the
+    server serves again."""
+    from tests.test_obs import parse_prometheus_text
+
+    srv, _, _, _ = server
+    host, port = srv.address
+    hot = {"utilization": 1.0, "queue_depth": 99.0, "p99_ms": 1e4,
+           "compile_inflight": 1.0}
+    real_signals = srv.shedder._signals_fn
+    srv.shedder._signals_fn = lambda: dict(hot)
+    try:
+        status, health = _get(host, port, "/healthz")
+        assert status == 200
+        assert health["status"] == "overloaded" and health["degraded"]
+        # POST routes shed with the retry contract.
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            conn.request("POST", "/predict", body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            retry_after = resp.getheader("Retry-After")
+            out = json.loads(resp.read())
+            assert resp.status == 429
+            assert int(retry_after) >= 1
+            assert out["retry_after_s"] > 0
+        finally:
+            conn.close()
+        # Observability stays live in degraded mode.
+        status, stats = _get(host, port, "/stats")
+        assert status == 200
+        assert stats["shedding"]["degraded"] is True
+        assert stats["shedding"]["reason"]
+        samples = parse_prometheus_text(srv.metrics_text())
+        assert samples[("di_shed_degraded", frozenset())] == 1.0
+        assert samples[("di_shed_rejected_total", frozenset())] >= 1
+    finally:
+        srv.shedder._signals_fn = real_signals
+    # Recovery: real signals are idle; after the (short, fixture-config)
+    # dwell the server serves again.
+    deadline = time.monotonic() + 5.0
+    while srv.shedder.evaluate() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    status, health = _get(host, port, "/healthz")
+    assert health["status"] == "ok" and not health["degraded"]
+    status, _ = _post_npz(host, port, fresh_raw(820))
+    assert status == 200
+    assert srv.shedder.stats()["transitions"] >= 2  # entered AND exited
 
 
 def test_sigterm_drain_completes_inflight_then_refuses(server):

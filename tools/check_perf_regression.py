@@ -63,6 +63,13 @@ TOLERANCES = {
     "screening.speedup_vs_naive": (0.35, +1),
     "screening.encode_reuse_ratio": (0.10, +1),
     "attribution.total_device_ms": (0.50, -1),
+    # Overload-safety contract (bench `saturation` section, ISSUE-11):
+    # the p99 ratio is the bounded-queue promise (lower = tighter tail
+    # under oversubscription); served throughput under overload must not
+    # collapse. Counts/rates are provenance, not gated.
+    "saturation.p99_ratio": (0.50, -1),
+    "saturation.served_per_sec": (0.35, +1),
+    "saturation.served_p99_ms": (0.50, -1),
 }
 # Keys whose values must match exactly for the runs to be comparable at
 # all (a different metric/unit is a different experiment, not a drift).
