@@ -1,6 +1,7 @@
 """Rule modules — importing this package populates the registry."""
 
 from deepinteract_tpu.analysis.rules import (  # noqa: F401
+    artifact_write,
     dead_cli_flag,
     dtype_discipline,
     jit_host_sync,

@@ -1,0 +1,71 @@
+"""Rule ``artifact-write``: no bare write-mode ``open()`` in the package.
+
+Persistence must go through ``robustness/artifacts.py`` (atomic tmp +
+fsync + replace, optional integrity sidecar) so a kill -9 can never tear
+a file a later run trusts — the ISSUE-12 durability contract. A bare
+``open(path, "w")`` anywhere else is exactly how the next subsystem
+quietly reintroduces torn-write bugs, so it is flagged at lint time.
+
+Flags calls to the BUILTIN ``open`` whose mode (second positional or
+``mode=`` keyword) is a string constant containing a write intent
+(``w``, ``a``, ``x``, or ``+``). Read-mode opens, non-constant modes,
+and method calls (``path.open``, ``gzip.open``) are out of scope.
+Sanctioned exceptions carry ``# di: allow[artifact-write] <reason>`` —
+streaming append sinks whose readers tolerate a torn tail, and
+regenerable offline build outputs. ``robustness/artifacts.py`` itself
+(the one place allowed to open tmp files for writing) is exempt, as are
+the repo-level script surfaces (``tools/``, ``bench.py``, tests).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Sequence
+
+from deepinteract_tpu.analysis.core import Finding, SourceFile, register
+
+RULE = "artifact-write"
+
+# The package is in scope; the durable layer itself and non-package
+# script surfaces are not.
+SCOPE_PREFIX = "deepinteract_tpu/"
+EXEMPT_FILES = ("deepinteract_tpu/robustness/artifacts.py",)
+
+MESSAGE = ("bare write-mode open() — persist through "
+           "robustness/artifacts.py (atomic_write / atomic_write_artifact)"
+           " or annotate why a torn file is tolerable")
+
+_WRITE_CHARS = set("wax+")
+
+
+def _write_mode(call: ast.Call) -> bool:
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default 'r'
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return False  # dynamic mode: undecidable, stay quiet
+    return bool(_WRITE_CHARS & set(mode.value))
+
+
+def in_scope(path: str) -> bool:
+    return path.startswith(SCOPE_PREFIX) and path not in EXEMPT_FILES
+
+
+@register(RULE, "no bare write-mode open() outside robustness/artifacts "
+                "(atomic writes + integrity sidecars)")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    for f in files:
+        if f.tree is None or not in_scope(f.path):
+            continue
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open"
+                    and _write_mode(node)):
+                yield Finding(rule=RULE, path=f.path, line=node.lineno,
+                              message=MESSAGE)

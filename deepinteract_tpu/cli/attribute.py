@@ -191,11 +191,9 @@ def main(argv=None) -> int:
         else os.path.dirname(args.profile_dir) or ".")
     if os.path.isdir(out_path) or not out_path.endswith(".json"):
         out_path = os.path.join(out_path, "op_attribution.json")
-    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
-    tmp = out_path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(report, fh, indent=2)
-    os.replace(tmp, out_path)
+    from deepinteract_tpu.robustness import artifacts
+
+    artifacts.atomic_write(out_path, json.dumps(report, indent=2))
 
     for op in report["top_ops"][:5]:
         print(f"  {op['name'][:40]:40s} {op['total_ms']:10.3f} ms "

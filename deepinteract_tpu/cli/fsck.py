@@ -1,0 +1,231 @@
+"""Artifact fsck: verify/quarantine/report over a run directory.
+
+``python -m deepinteract_tpu.cli.fsck RUNDIR`` walks everything a run
+persists — orbax checkpoint steps (``best/``/``last/``) with their tree
+integrity sidecars, the ``trainer_state.json`` sidecar, embedding-cache
+npz spills, screen manifests, tuning stores, heartbeats, download caches
+— and checks bytes-on-disk against the ``*.integrity.json`` manifests
+the durable-artifact layer (robustness/artifacts.py) writes:
+
+* **verified** — sidecar present, byte length and SHA-256 match;
+* **corrupt** — truncation, bit flip, unreadable sidecar, or a torn
+  orbax step (``_CHECKPOINT_METADATA`` missing). With ``--quarantine``
+  these are moved aside as ``<name>.corrupt-<ts>`` so the owning
+  subsystem's next run recovers automatically;
+* **unverified** — a known artifact with no sidecar (pre-integrity
+  writer); reported so the operator knows the coverage edge, JSON
+  artifacts get a parse sanity check;
+* **orphans** — ``*.tmp`` strays from killed writers (removed with
+  ``--sweep_tmp`` or ``--quarantine``) and sidecars whose target is gone.
+
+Exit codes: 0 = clean, or every corruption was quarantined this run
+(recovery complete); 1 = corruption present and left in place; 2 = bad
+invocation. The FINAL stdout line is the machine-readable ``fsck/v1``
+contract (tools/check_cli_contract.py kind ``fsck``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict
+
+from deepinteract_tpu.robustness import artifacts
+
+# Sidecar-less files fsck still recognizes and JSON-parse-checks (the
+# legacy coverage edge).
+KNOWN_UNVERIFIED_BASENAMES = ("trainer_state.json", "tuning_store.json")
+
+
+def _known_json_artifact(name: str) -> bool:
+    # Heartbeats are per-process files: obs/heartbeat_p<N>.json
+    # (training/loop.py) or any heartbeat*.json an operator configured.
+    return (name in KNOWN_UNVERIFIED_BASENAMES
+            or (name.startswith("heartbeat") and name.endswith(".json")))
+
+_SKIP_DIR_NAMES = {"__pycache__"}
+
+
+def _is_step_dir(path: str) -> bool:
+    """An orbax checkpoint step: an integer-named directory directly
+    under a ``best/`` or ``last/`` root."""
+    name = os.path.basename(path)
+    parent = os.path.basename(os.path.dirname(path))
+    return name.isdigit() and parent in ("best", "last")
+
+
+def _check_tree(path: str, report: Dict) -> None:
+    kind = artifacts.CHECKPOINT_KIND  # same label the restore path uses
+    try:
+        manifest = artifacts.verify_tree(path, require_sidecar=False)
+    except artifacts.ArtifactError as exc:
+        _mark_corrupt(path, str(exc), kind, report)
+        return
+    if manifest is None:
+        if not os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA")):
+            _mark_corrupt(path, "torn save: _CHECKPOINT_METADATA missing",
+                          kind, report)
+        else:
+            report["unverified_paths"].append(path)
+        return
+    if not os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA")):
+        _mark_corrupt(path, "torn save: _CHECKPOINT_METADATA missing",
+                      kind, report)
+        return
+    report["verified"] += 1
+
+
+def _check_file(path: str, report: Dict, require_sidecar: bool = False) -> None:
+    try:
+        manifest = artifacts.verify_file(path,
+                                         require_sidecar=require_sidecar)
+    except artifacts.ArtifactError as exc:
+        kind = "artifact"
+        sc = None
+        try:
+            sc = artifacts.read_sidecar(path)
+        except artifacts.ArtifactError:
+            pass
+        if isinstance(sc, dict):
+            kind = sc.get("kind", kind)
+        _mark_corrupt(path, str(exc), kind, report)
+        return
+    if manifest is None:
+        if _known_json_artifact(os.path.basename(path)):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    json.load(fh)
+            except (OSError, ValueError) as exc:
+                _mark_corrupt(path, f"unverified JSON artifact does not "
+                                    f"parse: {exc}", "legacy-json", report)
+                return
+        report["unverified_paths"].append(path)
+        return
+    report["verified"] += 1
+
+
+def _mark_corrupt(path: str, reason: str, kind: str, report: Dict) -> None:
+    report["corrupt_paths"].append({"path": path, "kind": kind,
+                                    "reason": reason})
+    if report["do_quarantine"]:
+        dest = artifacts.quarantine(path, kind, reason)
+        if dest is not None:
+            report["quarantined"] += 1
+            print(f"CORRUPT {path}: {reason} -> quarantined {dest}")
+            return
+    print(f"CORRUPT {path}: {reason}")
+
+
+def scan(root: str, do_quarantine: bool, do_sweep: bool) -> Dict:
+    report: Dict = {
+        "verified": 0, "quarantined": 0, "tmp_swept": 0,
+        "corrupt_paths": [], "unverified_paths": [], "orphan_sidecars": [],
+        "tmp_paths": [], "do_quarantine": do_quarantine,
+    }
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIR_NAMES
+                       and ".corrupt-" not in d]
+        # Directory artifacts first: a step dir is checked as one unit
+        # and not descended into (its files are covered by the tree
+        # sidecar; flagging each payload shard separately would be
+        # noise).
+        step_dirs = [d for d in list(dirnames)
+                     if _is_step_dir(os.path.join(dirpath, d))]
+        for d in step_dirs:
+            dirnames.remove(d)
+            _check_tree(os.path.join(dirpath, d), report)
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            if ".corrupt-" in name:
+                continue
+            if name.endswith(artifacts.TMP_SUFFIX):
+                report["tmp_paths"].append(path)
+                continue
+            if name.endswith(artifacts.SIDECAR_SUFFIX):
+                target = path[: -len(artifacts.SIDECAR_SUFFIX)]
+                if not os.path.exists(target):
+                    report["orphan_sidecars"].append(path)
+                continue
+            has_sidecar = os.path.exists(artifacts.sidecar_path(path))
+            # Embedding spills REQUIRE a sidecar (the cache quarantines
+            # strays at read); everything else degrades to unverified.
+            spill = name.startswith("emb_") and name.endswith(".npz")
+            if has_sidecar or spill or _known_json_artifact(name):
+                _check_file(path, report, require_sidecar=spill)
+    if do_sweep or do_quarantine:
+        for path in report["tmp_paths"]:
+            try:
+                os.unlink(path)
+                report["tmp_swept"] += 1
+            except OSError:
+                pass
+        for path in report["orphan_sidecars"]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("root", help="run directory to check (checkpoint "
+                                     "dir, spill dir, or a parent of both)")
+    parser.add_argument("--quarantine", action="store_true",
+                        help="move corrupt artifacts aside as "
+                             "<name>.corrupt-<ts> (and sweep tmp/orphan "
+                             "strays) so the next run recovers cleanly")
+    parser.add_argument("--sweep_tmp", action="store_true",
+                        help="remove orphaned *.tmp files from killed "
+                             "writers (report-only otherwise)")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(root):
+        print(f"error: {root} is not a directory", file=sys.stderr)
+        return 2
+
+    report = scan(root, args.quarantine, args.sweep_tmp)
+
+    for path in report["unverified_paths"]:
+        print(f"unverified (no integrity sidecar): {path}")
+    for path in report["orphan_sidecars"]:
+        print(f"orphan sidecar (target gone): {path}")
+    for path in report["tmp_paths"]:
+        swept = " (swept)" if (args.sweep_tmp or args.quarantine) else ""
+        print(f"orphan tmp: {path}{swept}")
+
+    corrupt = len(report["corrupt_paths"])
+    ok = corrupt == 0
+    recovered = corrupt > 0 and report["quarantined"] == corrupt
+    contract = {
+        "schema": "fsck/v1",
+        "metric": "fsck_corrupt_artifacts",
+        "value": float(corrupt),
+        "unit": "artifacts",
+        "ok": ok,
+        "root": root,
+        "scanned": report["verified"] + len(report["unverified_paths"])
+                   + corrupt,
+        "verified": report["verified"],
+        "unverified": len(report["unverified_paths"]),
+        "corrupt": corrupt,
+        "quarantined": report["quarantined"],
+        "recovered": recovered,
+        "orphan_sidecars": len(report["orphan_sidecars"]),
+        "tmp_files": len(report["tmp_paths"]),
+        "tmp_swept": report["tmp_swept"],
+        "corrupt_paths": [e["path"] for e in report["corrupt_paths"][:20]],
+    }
+    print(json.dumps(contract))
+    if ok or recovered:
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

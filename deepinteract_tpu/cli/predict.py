@@ -116,10 +116,11 @@ def main(argv=None) -> int:
 
         from deepinteract_tpu.screening.scoring import pair_summary
 
+        from deepinteract_tpu.robustness import artifacts
+
         summary = pair_summary(probs, args.top_k)
         contacts_path = os.path.join(args.output_dir, "top_contacts.json")
-        with open(contacts_path, "w") as fh:
-            json.dump(summary, fh, indent=1)
+        artifacts.atomic_write(contacts_path, json.dumps(summary, indent=1))
         # Final stdout line is machine-readable, mirroring screen/tune/
         # bench contract discipline (tools/check_cli_contract.py).
         print(json.dumps({

@@ -24,9 +24,12 @@ encode-reuse ratio and embedding-cache hit rate.
 from __future__ import annotations
 
 import csv
+import io
 import json
 import os
 import sys
+
+from deepinteract_tpu.robustness import artifacts
 
 from deepinteract_tpu.cli.args import (
     add_screening_args,
@@ -53,24 +56,23 @@ def build_library(args):
 
 
 def write_outputs(out_prefix: str, records) -> dict:
-    """Ranked JSONL + CSV; returns their paths."""
-    d = os.path.dirname(os.path.abspath(out_prefix))
-    os.makedirs(d, exist_ok=True)
+    """Ranked JSONL + CSV (atomic, robustness/artifacts.py); returns
+    their paths."""
     jsonl_path = out_prefix + ".jsonl"
-    with open(jsonl_path + ".tmp", "w") as fh:
-        for rank, rec in enumerate(records, start=1):
-            fh.write(json.dumps({"rank": rank, **rec}) + "\n")
-    os.replace(jsonl_path + ".tmp", jsonl_path)
+    lines = [json.dumps({"rank": rank, **rec})
+             for rank, rec in enumerate(records, start=1)]
+    artifacts.atomic_write(jsonl_path,
+                           "\n".join(lines) + ("\n" if lines else ""))
     csv_path = out_prefix + ".csv"
-    with open(csv_path + ".tmp", "w", newline="") as fh:
-        w = csv.writer(fh)
-        w.writerow(["rank", "pair_id", "chain1", "chain2", "n1", "n2",
-                    "score", "max_prob", "top_k"])
-        for rank, rec in enumerate(records, start=1):
-            w.writerow([rank, rec["pair_id"], rec["chain1"], rec["chain2"],
-                        rec["n1"], rec["n2"], f"{rec['score']:.6f}",
-                        f"{rec['max_prob']:.6f}", rec["top_k"]])
-    os.replace(csv_path + ".tmp", csv_path)
+    buf = io.StringIO()
+    w = csv.writer(buf)
+    w.writerow(["rank", "pair_id", "chain1", "chain2", "n1", "n2",
+                "score", "max_prob", "top_k"])
+    for rank, rec in enumerate(records, start=1):
+        w.writerow([rank, rec["pair_id"], rec["chain1"], rec["chain2"],
+                    rec["n1"], rec["n2"], f"{rec['score']:.6f}",
+                    f"{rec['max_prob']:.6f}", rec["top_k"]])
+    artifacts.atomic_write(csv_path, buf.getvalue())
     return {"jsonl": jsonl_path, "csv": csv_path}
 
 

@@ -81,6 +81,7 @@ class ComplexDataset:
             else:
                 rng = random.Random(seed)
                 names = rng.sample(names, max(1, int(len(names) * percent)))
+                # di: allow[artifact-write] seed-deterministic sample cache, regenerated if lost
                 with open(sampled_path, "w") as f:
                     f.write("\n".join(names) + "\n")
         return names

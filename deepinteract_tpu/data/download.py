@@ -33,8 +33,10 @@ from typing import Optional
 from urllib.error import ContentTooShortError, HTTPError, URLError
 
 from deepinteract_tpu.obs import metrics as obs_metrics
-from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.robustness import artifacts, faults
 from deepinteract_tpu.robustness.retry import retry
+
+DOWNLOAD_KIND = "download"
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +90,7 @@ def _fetch(url: str, tmp: str, timeout: float) -> None:
         "download.fetch",
         lambda: URLError("injected transient network failure"),
     )
+    # di: allow[artifact-write] streaming fetch into an mkstemp tmp; atomicity is the verified move below
     with urllib.request.urlopen(url, timeout=timeout) as resp, open(tmp, "wb") as f:
         shutil.copyfileobj(resp, f, length=1 << 20)
         written = f.tell()
@@ -107,14 +110,46 @@ def download_and_verify(url: str, dest: str, sha1: Optional[str] = None,
     An existing ``dest`` with a failing checksum raises unless
     ``overwrite=True``, which deletes and refetches it; the replacement is
     staged in a temp file and moved into place atomically, so a crash
-    mid-download never leaves a half-written ``dest``.
+    mid-download never leaves a half-written ``dest``. Truncation is a
+    RETRYABLE transport failure (Content-Length mismatch inside
+    ``_fetch``), never a cached half-file.
+
+    Completed downloads get a SHA-256 integrity sidecar
+    (robustness/artifacts.py), so a re-run skips files it can verify on
+    disk — including unchecksummed ones — and a corrupt cached file (bits
+    no longer matching the sidecar) is quarantined and refetched instead
+    of being trusted or crashing the build.
     """
     if os.path.exists(dest) and not overwrite:
-        if sha1 and sha1_of(dest) != sha1:
-            raise ValueError(
-                f"{dest} exists but fails its sha1 check; pass overwrite=True"
-            )
-        return dest
+        try:
+            manifest = artifacts.verify_file(dest, kind=DOWNLOAD_KIND,
+                                             require_sidecar=False)
+        except artifacts.ArtifactError as exc:
+            # Positive corruption against the recorded hash: quarantine
+            # and fall through to a fresh fetch.
+            artifacts.quarantine(dest, DOWNLOAD_KIND, str(exc))
+        else:
+            if manifest is None:
+                # Legacy file, no sidecar: the old sha1 gate, then adopt
+                # it into the sidecar regime so the NEXT re-run skips it
+                # on one streamed hash.
+                if sha1 and sha1_of(dest) != sha1:
+                    raise ValueError(
+                        f"{dest} exists but fails its sha1 check; pass "
+                        "overwrite=True")
+                artifacts.write_sidecar(dest, DOWNLOAD_KIND,
+                                        extra={"url": url, "sha1": sha1})
+                return dest
+            recorded = (manifest.get("extra") or {}).get("sha1")
+            if sha1 and recorded and recorded != sha1:
+                raise ValueError(
+                    f"{dest} exists but was recorded with sha1 {recorded}, "
+                    f"not the requested {sha1}; pass overwrite=True")
+            if sha1 and not recorded and sha1_of(dest) != sha1:
+                raise ValueError(
+                    f"{dest} exists but fails its sha1 check; pass "
+                    "overwrite=True")
+            return dest
     if timeout is None:
         raw = os.environ.get("DI_DOWNLOAD_TIMEOUT")
         try:
@@ -137,6 +172,11 @@ def download_and_verify(url: str, dest: str, sha1: Optional[str] = None,
             _REFETCHES.inc()
             logger.info("overwrite: replacing %s (failed or forced)", dest)
         shutil.move(tmp, dest)
+        # Completed + verified: record the SHA-256 so re-runs skip this
+        # file after one streamed hash instead of refetching or trusting
+        # it blindly.
+        artifacts.write_sidecar(dest, DOWNLOAD_KIND,
+                                extra={"url": url, "sha1": sha1})
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)

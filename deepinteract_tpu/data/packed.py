@@ -30,6 +30,8 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from deepinteract_tpu.robustness import artifacts
+
 INDEX_NAME = "pack_index.json"
 _PACK_VERSION = 1
 
@@ -122,9 +124,7 @@ def pack_dataset(dataset, out_dir: str, item_bucket_fn,
             "indices": idxs,
             "num_leaves": len(writers),
         }
-    with open(index_path + ".tmp", "w") as fh:
-        json.dump(index, fh)
-    os.replace(index_path + ".tmp", index_path)
+    artifacts.atomic_write(index_path, json.dumps(index))
     return out_dir
 
 

@@ -71,13 +71,17 @@ class Heartbeat:
         return out
 
     def write_now(self) -> None:
-        """One atomic write (also called on stop, so the final state —
-        e.g. the last completed step — survives the process)."""
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        tmp = f"{self.path}.{os.getpid()}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self.payload(), f)
-        os.replace(tmp, self.path)
+        """One atomic write via robustness/artifacts (also called on
+        stop, so the final state — e.g. the last completed step —
+        survives the process). A liveness scraper can therefore never
+        observe torn JSON. ``fsync=False``: a heartbeat's value is its
+        freshness, not its crash-durability — losing the very last beat
+        to power loss is indistinguishable from dying a beat earlier,
+        and fsync every interval on a shared filesystem is real load."""
+        from deepinteract_tpu.robustness import artifacts
+
+        artifacts.atomic_write(self.path, json.dumps(self.payload()),
+                               fsync=False)
 
     def start(self) -> "Heartbeat":
         if self._thread is not None:

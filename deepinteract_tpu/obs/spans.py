@@ -73,6 +73,7 @@ def configure(path: str, max_mb: float = DEFAULT_MAX_MB) -> None:
     with _lock:
         if _sink_file is not None:
             _sink_file.close()
+        # di: allow[artifact-write] append-only JSONL sink; readers tolerate a torn tail line
         _sink_file = open(path, "a", encoding="utf-8")
         _sink_path = path
         _sink_bytes = 0

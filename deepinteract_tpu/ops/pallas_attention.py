@@ -358,11 +358,11 @@ def record_attention_ab(path: str, batch: int, pad: int, dtype: str,
     per_dtype = entries.setdefault(bucket, {}).setdefault(str(dtype), {})
     per_dtype.update({k: float(v) for k, v in speedups.items()
                       if v is not None})
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump({"schema": AB_SCHEMA, "entries": entries}, fh, indent=2)
-        fh.write("\n")
-    os.replace(tmp, path)
+    from deepinteract_tpu.robustness import artifacts
+
+    artifacts.atomic_write(
+        path, json.dumps({"schema": AB_SCHEMA, "entries": entries},
+                         indent=2) + "\n")
     with _ab_lock:
         _ab_cache.update(path=None, mtime=None, data=None)
 

@@ -137,6 +137,7 @@ def _run_hhblits(sequence: str, bin_path: str, db_path: str) -> np.ndarray:
     with tempfile.TemporaryDirectory() as tmp:
         fasta = os.path.join(tmp, "query.fasta")
         hhm = os.path.join(tmp, "query.hhm")
+        # di: allow[artifact-write] transient hhblits input inside a TemporaryDirectory
         with open(fasta, "w") as f:
             f.write(">query\n" + sequence + "\n")
         subprocess.run(

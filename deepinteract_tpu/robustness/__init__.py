@@ -13,7 +13,11 @@ dedicated, individually-testable module here:
 * :mod:`retry` — exponential backoff with jitter and a deadline for
   flaky I/O and native tooling (downloads, compiles, HH-suite);
 * :mod:`faults` — deterministic fault injection powering the chaos test
-  suite (``tests/test_fault_tolerance.py``) and manual game-days.
+  suite (``tests/test_fault_tolerance.py``) and manual game-days;
+* :mod:`artifacts` — durable persistence: atomic writes, SHA-256
+  integrity sidecars with typed ``CorruptArtifact``/``StaleArtifact``
+  verification, quarantine, and the orphaned-tmp sweep
+  (``tests/test_artifacts.py``, ``cli/fsck.py``).
 
 Everything is dependency-free (stdlib + numpy/jax already in the tree)
 and degrades to zero overhead when disabled.
@@ -24,6 +28,11 @@ must not drag jax/optax (multi-second imports that can claim accelerator
 devices) into processes that never train.
 """
 
+from deepinteract_tpu.robustness.artifacts import (  # noqa: F401
+    ArtifactError,
+    CorruptArtifact,
+    StaleArtifact,
+)
 from deepinteract_tpu.robustness.preemption import (  # noqa: F401
     PreemptionGuard,
     TrainingPreempted,

@@ -1,0 +1,463 @@
+"""Durable artifacts: checksummed persistence, verified reads, quarantine.
+
+Every stateful subsystem here persists something — orbax checkpoints plus
+the ``trainer_state.json`` sidecar, the embedding-cache npz spill, screen
+manifests, the tuning store, heartbeats, download caches — and a
+production deployment on preemptible capacity cannot treat the disk that
+holds them as trustworthy: a kill -9 mid-write tears files, a flaky
+device flips bits, and a torn ``last/`` checkpoint used to block
+``--resume`` outright. This module is the single integrity layer they
+all write through:
+
+* :func:`atomic_write` — tmp + flush + fsync + ``os.replace`` + directory
+  fsync. A reader never observes a torn file; a crash leaves at worst an
+  orphaned ``*.tmp`` (cleaned by :func:`sweep_tmp` / ``cli/fsck.py``),
+  never a half-written destination.
+* **Integrity sidecars** — ``<name>.integrity.json`` records the SHA-256,
+  byte length, and schema kind/version of the artifact (plus caller
+  extras such as ``weights_signature``). :func:`verify_file` /
+  :func:`verify_read` check bytes-on-disk against the sidecar before any
+  deserializer runs, raising typed :class:`CorruptArtifact` /
+  :class:`StaleArtifact` instead of feeding garbage downstream.
+* :func:`quarantine` — a corrupt artifact is moved aside as
+  ``<name>.corrupt-<ts>`` (sidecar too), counted in
+  ``di_artifact_corrupt_total{kind}``, and logged with one reason line,
+  so recovery is automatic AND auditable — never a silent delete.
+* :func:`sweep_tmp` — startup sweep of orphaned ``*.tmp`` files from
+  killed runs.
+* **Directory trees** (orbax checkpoint steps): :func:`write_tree_sidecar`
+  / :func:`verify_tree` hash every file under the step directory, so a
+  single flipped bit in any payload shard fails verification.
+
+Write-ordering note: the artifact file is replaced first, then its
+sidecar. A crash between the two leaves a fresh file with a stale
+sidecar — which verification rejects (fail-closed) and the owning
+subsystem recovers from (fall back / re-derive), the same path as real
+corruption. No ordering can make two files one atom; fail-closed is the
+safe half.
+
+Chaos hooks (robustness/faults.py): ``storage.write`` fails before the
+tmp is written, ``storage.fsync`` after content is in the tmp (the torn-
+tmp crash point), ``storage.replace`` before the rename (complete tmp,
+old destination), and ``storage.read`` poisons a verified read — so the
+chaos suite can kill every write at every stage and corrupt every read,
+deterministically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.robustness import faults
+
+logger = logging.getLogger(__name__)
+
+SCHEMA = "artifact-integrity/v1"
+SIDECAR_SUFFIX = ".integrity.json"
+TMP_SUFFIX = ".tmp"
+
+# Schema kind of orbax checkpoint-step tree sidecars. Lives here (not in
+# training/checkpoint.py) so file-only consumers — cli/fsck.py — can
+# label the same artifact class identically without importing the
+# jax/orbax-heavy training stack.
+CHECKPOINT_KIND = "orbax-checkpoint"
+
+_CORRUPT = obs_metrics.counter(
+    "di_artifact_corrupt_total",
+    "Corrupt artifacts detected and quarantined, by schema kind",
+    labelnames=("kind",))
+_TMP_SWEPT = obs_metrics.counter(
+    "di_artifact_tmp_swept_total",
+    "Orphaned .tmp files removed by the startup sweep")
+
+
+class ArtifactError(RuntimeError):
+    """Base of typed artifact-integrity failures."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"{path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+class CorruptArtifact(ArtifactError):
+    """Bytes on disk do not match the integrity sidecar (truncation, bit
+    flip, torn write, unparseable sidecar). The artifact must not be
+    deserialized; quarantine and recover."""
+
+
+class StaleArtifact(ArtifactError):
+    """The artifact is intact but is not the one the reader wants: wrong
+    schema kind/version, or an ``expect`` field (e.g. weights_signature)
+    disagrees. Never silently reinterpreted."""
+
+
+# -- hashing ---------------------------------------------------------------
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def sidecar_path(path: str) -> str:
+    return path + SIDECAR_SUFFIX
+
+
+# -- atomic writes ---------------------------------------------------------
+
+
+def _fsync_dir(directory: str) -> None:
+    """fsync the containing directory so the rename itself is durable
+    (POSIX: a crash after replace but before the dir sync can otherwise
+    forget the new directory entry)."""
+    fd = os.open(directory or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: Union[bytes, str], *,
+                 fsync: bool = True) -> None:
+    """Write ``data`` to ``path`` so a reader sees the old content or the
+    new content, never a mixture — and, with ``fsync`` (default), so the
+    new content survives power loss once this returns.
+
+    A failure mid-sequence may leave an orphaned ``<path>.<pid>.tmp``
+    (exactly what a kill -9 leaves); it is NOT cleaned up here so the
+    fault-injected paths model the crash faithfully — :func:`sweep_tmp`
+    owns orphan cleanup. ``fsync=False`` is for freshness files
+    (heartbeats) whose value is atomicity, not durability.
+    """
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    faults.maybe_raise(
+        "storage.write", lambda: OSError("injected storage.write fault"))
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}{TMP_SUFFIX}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        faults.maybe_raise(
+            "storage.fsync", lambda: OSError("injected storage.fsync fault"))
+        if fsync:
+            os.fsync(f.fileno())
+    faults.maybe_raise(
+        "storage.replace", lambda: OSError("injected storage.replace fault"))
+    os.replace(tmp, path)
+    if fsync:
+        _fsync_dir(directory)
+
+
+def _write_sidecar_from(path: str, kind: str, version: int,
+                        extra: Optional[Dict[str, Any]],
+                        digest: str, nbytes: int) -> Dict[str, Any]:
+    manifest: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "kind": kind,
+        "version": int(version),
+        "sha256": digest,
+        "bytes": int(nbytes),
+        "written_at": time.time(),
+    }
+    if extra:
+        manifest["extra"] = dict(extra)
+    atomic_write(sidecar_path(path), json.dumps(manifest, sort_keys=True))
+    return manifest
+
+
+def write_sidecar(path: str, kind: str, version: int = 1,
+                  extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Stream-hash an EXISTING file and write its integrity sidecar
+    (adopting artifacts not written by this process — downloads, legacy
+    files). Returns the manifest dict."""
+    return _write_sidecar_from(path, kind, version, extra,
+                               sha256_file(path), os.path.getsize(path))
+
+
+def atomic_write_artifact(path: str, data: Union[bytes, str], kind: str,
+                          version: int = 1,
+                          extra: Optional[Dict[str, Any]] = None) -> None:
+    """:func:`atomic_write` + integrity sidecar — the standard way to
+    persist a verifiable single-file artifact. The sidecar hash is
+    computed from the in-memory bytes, not a re-read of the file, so a
+    durable write costs one write pass, not two I/O passes."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    atomic_write(path, data)
+    _write_sidecar_from(path, kind, version, extra,
+                        hashlib.sha256(data).hexdigest(), len(data))
+
+
+# -- verified reads --------------------------------------------------------
+
+
+def read_sidecar(path: str) -> Optional[Dict[str, Any]]:
+    """The parsed sidecar for ``path``, None when absent, and
+    :class:`CorruptArtifact` when present but unreadable (a truncated
+    sidecar is corruption of the artifact pair, not a missing one)."""
+    sc = sidecar_path(path)
+    if not os.path.exists(sc):
+        return None
+    try:
+        with open(sc, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise CorruptArtifact(path, f"unreadable integrity sidecar: {exc}")
+    if not isinstance(manifest, dict) or manifest.get("schema") != SCHEMA:
+        found = manifest.get("schema") if isinstance(manifest, dict) else type(manifest).__name__
+        raise CorruptArtifact(path, f"sidecar schema {found!r} != {SCHEMA}")
+    return manifest
+
+
+def _check_manifest(path: str, manifest: Dict[str, Any],
+                    kind: Optional[str], expect: Optional[Dict[str, Any]],
+                    size: int, digest: str) -> None:
+    """The shared identity + integrity checks behind verify_file /
+    verify_read / verify_tree entries."""
+    if kind is not None and manifest.get("kind") != kind:
+        raise StaleArtifact(
+            path, f"kind {manifest.get('kind')!r} != expected {kind!r}")
+    for key, want in (expect or {}).items():
+        got = (manifest.get("extra") or {}).get(key)
+        if got != want:
+            raise StaleArtifact(path, f"{key} {got!r} != expected {want!r}")
+    if size != manifest.get("bytes"):
+        raise CorruptArtifact(
+            path, f"truncated: {size} bytes on disk, sidecar recorded "
+                  f"{manifest.get('bytes')}")
+    if digest != manifest.get("sha256"):
+        raise CorruptArtifact(
+            path, f"sha256 mismatch: {digest[:12]}… on disk, sidecar "
+                  f"recorded {str(manifest.get('sha256'))[:12]}…")
+
+
+def verify_file(path: str, kind: Optional[str] = None, *,
+                require_sidecar: bool = True,
+                expect: Optional[Dict[str, Any]] = None,
+                ) -> Optional[Dict[str, Any]]:
+    """Check ``path`` against its integrity sidecar without reading it
+    into memory (streamed hash — right for large files the caller won't
+    load, e.g. downloads). Returns the manifest, or None when no sidecar
+    exists and ``require_sidecar`` is False (legacy artifact: caller
+    proceeds unverified).
+
+    Raises FileNotFoundError (no such artifact), :class:`CorruptArtifact`
+    (missing required sidecar, byte-length mismatch = truncation, hash
+    mismatch = bit flip/torn write, unreadable sidecar), or
+    :class:`StaleArtifact` (kind or ``expect`` mismatch — e.g. a spill
+    written under different weights).
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if faults.fire("storage.read"):
+        raise CorruptArtifact(path, "injected storage.read corruption")
+    manifest = read_sidecar(path)
+    if manifest is None:
+        if require_sidecar:
+            raise CorruptArtifact(path, "integrity sidecar missing")
+        return None
+    _check_manifest(path, manifest, kind, expect,
+                    os.path.getsize(path), sha256_file(path))
+    return manifest
+
+
+def verify_read(path: str, kind: Optional[str] = None, *,
+                require_sidecar: bool = True,
+                expect: Optional[Dict[str, Any]] = None) -> bytes:
+    """Read the artifact's bytes ONCE and verify that exact buffer
+    against the sidecar (hash computed in memory — no second I/O pass,
+    and no verify-then-reread window: the bytes returned are the bytes
+    checked)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if faults.fire("storage.read"):
+        raise CorruptArtifact(path, "injected storage.read corruption")
+    manifest = read_sidecar(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    if manifest is None:
+        if require_sidecar:
+            raise CorruptArtifact(path, "integrity sidecar missing")
+        return data
+    _check_manifest(path, manifest, kind, expect,
+                    len(data), hashlib.sha256(data).hexdigest())
+    return data
+
+
+def verify_json(path: str, kind: Optional[str] = None, *,
+                require_sidecar: bool = True,
+                expect: Optional[Dict[str, Any]] = None) -> Any:
+    """Verified read + JSON decode. A decode failure after a passing
+    hash check means the WRITER persisted garbage — still surfaced as
+    :class:`CorruptArtifact` so every caller has one error to handle."""
+    raw = verify_read(path, kind, require_sidecar=require_sidecar,
+                      expect=expect)
+    try:
+        return json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise CorruptArtifact(path, f"verified bytes are not JSON: {exc}")
+
+
+# -- directory trees (orbax checkpoint steps) ------------------------------
+
+
+def _tree_files(dir_path: str) -> Dict[str, str]:
+    out = {}
+    for root, _dirs, files in os.walk(dir_path):
+        for name in files:
+            p = os.path.join(root, name)
+            out[os.path.relpath(p, dir_path).replace(os.sep, "/")] = p
+    return out
+
+
+def write_tree_sidecar(dir_path: str, kind: str, version: int = 1,
+                       extra: Optional[Dict[str, Any]] = None,
+                       ) -> Dict[str, Any]:
+    """Integrity sidecar for a DIRECTORY artifact (an orbax step dir):
+    per-file sha256 + byte length for every file under it, written next
+    to the directory as ``<dir>.integrity.json``."""
+    files = {
+        rel: {"sha256": sha256_file(p), "bytes": os.path.getsize(p)}
+        for rel, p in sorted(_tree_files(dir_path).items())
+    }
+    manifest: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "kind": kind,
+        "version": int(version),
+        "tree": True,
+        "files": files,
+        "bytes": sum(e["bytes"] for e in files.values()),
+        "written_at": time.time(),
+    }
+    if extra:
+        manifest["extra"] = dict(extra)
+    atomic_write(sidecar_path(dir_path), json.dumps(manifest, sort_keys=True))
+    return manifest
+
+
+def verify_tree(dir_path: str, kind: Optional[str] = None, *,
+                require_sidecar: bool = True,
+                ) -> Optional[Dict[str, Any]]:
+    """Verify every file of a directory artifact against its tree
+    sidecar. Missing, truncated, altered, AND unexpected-extra files all
+    raise :class:`CorruptArtifact` — a finalized checkpoint step never
+    legitimately changes shape after its sidecar is written."""
+    if not os.path.isdir(dir_path):
+        raise FileNotFoundError(dir_path)
+    if faults.fire("storage.read"):
+        raise CorruptArtifact(dir_path, "injected storage.read corruption")
+    manifest = read_sidecar(dir_path)
+    if manifest is None:
+        if require_sidecar:
+            raise CorruptArtifact(dir_path, "integrity sidecar missing")
+        return None
+    if kind is not None and manifest.get("kind") != kind:
+        raise StaleArtifact(
+            dir_path, f"kind {manifest.get('kind')!r} != expected {kind!r}")
+    recorded = manifest.get("files")
+    if not isinstance(recorded, dict):
+        raise CorruptArtifact(dir_path, "sidecar carries no file map")
+    on_disk = _tree_files(dir_path)
+    missing = sorted(set(recorded) - set(on_disk))
+    if missing:
+        raise CorruptArtifact(
+            dir_path, f"{len(missing)} recorded file(s) missing "
+                      f"(first: {missing[0]})")
+    extra_files = sorted(set(on_disk) - set(recorded))
+    if extra_files:
+        raise CorruptArtifact(
+            dir_path, f"{len(extra_files)} file(s) not in the sidecar "
+                      f"(first: {extra_files[0]}) — partial overwrite?")
+    for rel, entry in recorded.items():
+        p = on_disk[rel]
+        size = os.path.getsize(p)
+        if size != entry.get("bytes"):
+            raise CorruptArtifact(
+                dir_path, f"{rel}: truncated ({size} bytes vs recorded "
+                          f"{entry.get('bytes')})")
+        if sha256_file(p) != entry.get("sha256"):
+            raise CorruptArtifact(dir_path, f"{rel}: sha256 mismatch")
+    return manifest
+
+
+# -- quarantine + sweep ----------------------------------------------------
+
+
+def quarantine(path: str, kind: str, reason: str) -> Optional[str]:
+    """Move a corrupt artifact (file or directory) and its sidecar aside
+    as ``<name>.corrupt-<ts>``, count it, and log the one reason line.
+    Returns the quarantine path, or None when the move itself failed
+    (full disk/permissions — the corruption is still counted+logged)."""
+    ts = int(time.time())
+    dest = f"{path}.corrupt-{ts}"
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = f"{path}.corrupt-{ts}.{n}"
+    _CORRUPT.inc(kind=kind)
+    try:
+        os.replace(path, dest)
+    except OSError as exc:
+        logger.error("corrupt artifact %s (%s): %s — quarantine move "
+                     "FAILED: %s", path, kind, reason, exc)
+        return None
+    sc = sidecar_path(path)
+    if os.path.exists(sc):
+        try:
+            os.replace(sc, sidecar_path(dest))
+        except OSError:  # the payload is already aside; sidecar orphan
+            pass  # is cleaned by fsck
+    logger.error("corrupt artifact %s (%s): %s — quarantined to %s",
+                 path, kind, reason, dest)
+    return dest
+
+
+def sweep_tmp(directory: str, prefix: str = "",
+              contains: str = "") -> List[str]:
+    """Remove orphaned ``*.tmp`` files left by killed writers, directly
+    under ``directory`` (non-recursive — each subsystem sweeps its own
+    root at startup, when none of ITS writers can be mid-flight).
+    ``prefix`` (basename start) and ``contains`` (substring, e.g.
+    ``".integrity.json."``) restrict the sweep to tmps this subsystem
+    owns, so one sharing a directory never reaps a neighbor's live
+    write. Returns the removed paths; never raises on per-file errors."""
+    removed: List[str] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return removed
+    for name in names:
+        if not name.endswith(TMP_SUFFIX):
+            continue
+        if prefix and not name.startswith(prefix):
+            continue
+        if contains and contains not in name:
+            continue
+        p = os.path.join(directory, name)
+        if not os.path.isfile(p):
+            continue
+        try:
+            os.unlink(p)
+        except OSError:
+            continue
+        _TMP_SWEPT.inc()
+        removed.append(p)
+    if removed:
+        logger.warning("swept %d orphaned tmp file(s) under %s",
+                       len(removed), directory)
+    return removed

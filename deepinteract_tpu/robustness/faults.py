@@ -30,6 +30,18 @@ Registered sites:
 * ``serving.dispatch``   — raises BatchExecutionError at the coalesced
   batch's device dispatch (fails only that group; the worker and the
   engine keep serving — tests/test_serving.py chaos suite)
+* ``storage.write``      — raises OSError before an atomic_write opens
+  its tmp file (robustness/artifacts.py)
+* ``storage.fsync``      — raises OSError after the tmp holds the full
+  content but before fsync — the torn-tmp crash point (orphan tmp left,
+  destination untouched)
+* ``storage.replace``    — raises OSError before the atomic rename
+  (complete tmp orphaned, destination still the old version)
+* ``storage.read``       — poisons a verified read/verify with a
+  CorruptArtifact (simulated on-disk corruption)
+* ``checkpoint.restore`` — marks a checkpoint step corrupt at restore
+  verification, driving the last-good fallback walk
+  (training/checkpoint.py)
 
 When no plan is configured every probe is a dict lookup on an empty map —
 effectively free on hot paths.

@@ -117,8 +117,7 @@ def dump_diagnostics(directory: str, payload: Dict[str, Any]) -> str:
         f"nonfinite_abort_epoch{payload.get('epoch', 'x')}"
         f"_step{payload.get('step', 'x')}.json",
     )
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=2, default=str)
-    os.replace(tmp, path)
+    from deepinteract_tpu.robustness import artifacts
+
+    artifacts.atomic_write(path, json.dumps(payload, indent=2, default=str))
     return path

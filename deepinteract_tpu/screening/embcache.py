@@ -13,15 +13,22 @@ Two tiers:
   all-vs-all screen is the library itself, so the default capacity covers
   thousands of chains before eviction matters;
 * **optional on-disk npz spill** — entries evicted from memory are written
-  to ``spill_dir`` (atomic tmp+rename) and transparently reloaded on a
-  later get, so a library larger than memory still encodes each chain
-  once per screen, and a RESUMED screen (robustness/preemption.py) skips
-  re-encoding everything the killed run already paid for.
+  to ``spill_dir`` (robustness/artifacts.py: atomic write + SHA-256
+  integrity sidecar) and transparently reloaded on a later get, so a
+  library larger than memory still encodes each chain once per screen,
+  and a RESUMED screen (robustness/preemption.py) skips re-encoding
+  everything the killed run already paid for. A spill read is VERIFIED
+  before np.load ever parses it: a truncated or bit-flipped file is
+  quarantined and served as a miss (the chain is re-encoded), never
+  admitted as a silently wrong embedding; a payload whose sidecar hasn't
+  landed yet (concurrent spill mid-write, or a kill between the two
+  writes) is a plain miss and is healed whole by the next re-spill.
 """
 
 from __future__ import annotations
 
 import hashlib
+import io
 import os
 import threading
 from collections import OrderedDict
@@ -31,6 +38,9 @@ import numpy as np
 
 from deepinteract_tpu.data.io import GRAPH_KEYS
 from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.robustness import artifacts
+
+SPILL_KIND = "embcache-spill"
 
 _HITS = obs_metrics.counter(
     "di_screen_embedding_cache_hits_total",
@@ -72,6 +82,9 @@ class EmbeddingCache:
         self.spill_dir = spill_dir
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
+            # A killed run's mid-flight spill leaves only an orphaned
+            # tmp; its destination is whole or absent (atomic replace).
+            artifacts.sweep_tmp(spill_dir, prefix="emb_")
         self._entries: "OrderedDict[str, Tuple[np.ndarray, int]]" = (
             OrderedDict())
         self._lock = threading.Lock()
@@ -97,14 +110,41 @@ class EmbeddingCache:
         if self.spill_dir:
             path = self._spill_path(key)
             if os.path.exists(path):
+                if not os.path.exists(artifacts.sidecar_path(path)):
+                    # Payload landed but no sidecar YET: a concurrent
+                    # _spill is between its two writes (or a kill landed
+                    # there). A miss — NOT a quarantine of a healthy
+                    # mid-write file; _spill heals the sidecar on the
+                    # re-spill after this miss's re-encode.
+                    with self._lock:
+                        self._misses += 1
+                    _MISSES.inc()
+                    return None
                 try:
-                    with np.load(path, allow_pickle=False) as z:
+                    # Integrity gate BEFORE the deserializer: without it,
+                    # only np.load's format checks stood between a
+                    # flipped bit and a wrong embedding — and a bit flip
+                    # inside the float payload passes format checks.
+                    raw = artifacts.verify_read(path, kind=SPILL_KIND)
+                    with np.load(io.BytesIO(raw), allow_pickle=False) as z:
                         feats = np.asarray(z["feats"], dtype=np.float32)
                         n = int(z["n"])
-                except Exception:  # truncated spill (killed mid-write
-                    # before the atomic rename should make this
-                    # unreachable, but a corrupt file must read as a
-                    # miss, not kill the screen)
+                except (artifacts.ArtifactError, ValueError,
+                        KeyError) as exc:
+                    # Positive corruption (hash/length/sidecar mismatch)
+                    # or verified-bytes-that-won't-deserialize (writer
+                    # bug): quarantine and re-encode (a miss), never
+                    # kill the screen or admit garbage.
+                    if os.path.exists(path):
+                        artifacts.quarantine(path, SPILL_KIND, str(exc))
+                    with self._lock:
+                        self._misses += 1
+                    _MISSES.inc()
+                    return None
+                except OSError:
+                    # TRANSIENT read failure (or the file vanished): a
+                    # plain miss — the intact spill stays in place for
+                    # the next attempt, no false corruption signal.
                     with self._lock:
                         self._misses += 1
                     _MISSES.inc()
@@ -143,23 +183,30 @@ class EmbeddingCache:
         if not self.spill_dir:
             return
         path = self._spill_path(key)
-        if os.path.exists(path):
+        if (os.path.exists(path)
+                and os.path.exists(artifacts.sidecar_path(path))):
+            # Complete pair already on disk (content-addressed: same key
+            # = same bytes). A payload WITHOUT its sidecar — a kill
+            # between the two writes — is rewritten whole, healing it.
             return
-        tmp = path + ".tmp"
         try:
-            # Through a file handle: np.savez given a PATH appends ".npz",
-            # which would break the tmp+rename atomicity dance.
-            with open(tmp, "wb") as fh:
-                np.savez(fh, feats=feats, n=np.int64(n))
-            os.replace(tmp, path)
+            # Serialize in memory, then one atomic_write + sidecar: the
+            # destination is only ever a COMPLETE npz with a matching
+            # hash, so a reader (or a resumed run) can verify-then-load.
+            # The key already binds weights_signature/bucket/dtype
+            # (chain_hash extras), so sidecar extras carry only n.
+            buf = io.BytesIO()
+            np.savez(buf, feats=feats, n=np.int64(n))
+            artifacts.atomic_write_artifact(
+                path, buf.getvalue(), SPILL_KIND, extra={"n": int(n)})
             with self._lock:
                 self._spills += 1
             _SPILLS.inc()
         except OSError:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+            # Failed spill (disk full / injected storage fault): drop the
+            # entry — it will be re-encoded — and let the startup sweep
+            # collect any orphaned tmp.
+            pass
 
     def __len__(self) -> int:
         with self._lock:

@@ -11,15 +11,29 @@ The manifest stores each completed pair's full score record, so the final
 ranked JSONL/CSV can always be regenerated from the manifest alone — a
 resumed run's output covers the whole screen, not just its own slice.
 The library signature guards against resuming over different data.
+
+Durability (robustness/artifacts.py): flushes carry a SHA-256 integrity
+sidecar and loads verify it before parsing. A corrupt manifest (torn,
+truncated, bit-flipped — or one whose sidecar is) is quarantined aside
+with a logged reason and the screen starts FRESH: loudly recoverable —
+the lost batches are simply re-derived and re-scored, which costs
+compute but can never adopt a wrong ledger. A sidecar-less manifest from
+an older run still resumes (legacy-unverified, warned).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from deepinteract_tpu.robustness import artifacts
+
+logger = logging.getLogger(__name__)
+
 MANIFEST_VERSION = 1
+MANIFEST_KIND = "screen-manifest"
 
 
 def pair_id(chain1: str, chain2: str) -> str:
@@ -43,20 +57,41 @@ class ScreenManifest:
     def load_or_create(cls, path: str, signature: str,
                        total_pairs: int) -> Tuple["ScreenManifest", bool]:
         """(manifest, resumed). An existing manifest is resumed only when
-        its version AND library signature match; anything else starts
-        fresh (the stale file is kept aside as ``<path>.stale`` rather
-        than silently merged into a different screen)."""
+        it verifies against its integrity sidecar AND its version and
+        library signature match. A corrupt file is quarantined (fresh
+        start — lost batches re-derive); a mismatched-but-intact one is
+        kept aside as ``<path>.stale`` rather than silently merged into a
+        different screen."""
+        artifacts.sweep_tmp(os.path.dirname(os.path.abspath(path)),
+                            prefix=os.path.basename(path))
         if os.path.exists(path):
+            data = None
             try:
-                with open(path) as fh:
-                    data = json.load(fh)
-            except (OSError, json.JSONDecodeError):
-                data = None
+                raw = artifacts.verify_read(path, kind=MANIFEST_KIND,
+                                            require_sidecar=False)
+                data = json.loads(raw.decode("utf-8"))
+            except (artifacts.ArtifactError, UnicodeDecodeError,
+                    json.JSONDecodeError) as exc:
+                # Positive corruption (hash/length mismatch, unreadable
+                # sidecar, or unparseable verified bytes): quarantine and
+                # start fresh — loud, recoverable, never adopted.
+                artifacts.quarantine(path, MANIFEST_KIND, str(exc))
+            except OSError as exc:
+                # TRANSIENT read failure (flaky FS), not corruption: the
+                # ledger may be intact, so keep it aside as .stale rather
+                # than letting the fresh manifest's first flush overwrite
+                # it (pre-integrity behavior, preserved).
+                logger.warning("could not read screen manifest %s (%s); "
+                               "keeping it aside as .stale", path, exc)
             if (data and data.get("version") == MANIFEST_VERSION
                     and data.get("signature") == signature):
                 return cls(path, signature, total_pairs,
                            completed=data.get("completed", {})), True
-            os.replace(path, path + ".stale")
+            if os.path.exists(path):
+                try:
+                    os.replace(path, path + ".stale")
+                except OSError:
+                    pass
         return cls(path, signature, total_pairs), False
 
     def mark_done(self, pid: str, record: Dict) -> None:
@@ -75,12 +110,10 @@ class ScreenManifest:
             "num_completed": len(self.completed),
             "completed": self.completed,
         }
-        tmp = self.path + ".tmp"
-        d = os.path.dirname(os.path.abspath(self.path))
-        os.makedirs(d, exist_ok=True)
-        with open(tmp, "w") as fh:
-            json.dump(payload, fh)
-        os.replace(tmp, self.path)
+        artifacts.atomic_write_artifact(
+            self.path, json.dumps(payload), MANIFEST_KIND,
+            version=MANIFEST_VERSION,
+            extra={"signature": self.signature})
         self._dirty = False
 
     # -- queries -----------------------------------------------------------

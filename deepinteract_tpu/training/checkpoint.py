@@ -5,16 +5,51 @@ the reference (``lit_model_train.py:139-151``): monitor a chosen metric
 (mode 'min' iff its name contains 'ce', exactly the reference's rule),
 keep the top ``save_top_k`` checkpoints plus always the latest
 (``save_top_k=3, save_last=True``, ``lit_model_train.py:144-151``).
+
+Durability (robustness/artifacts.py): every retained step directory gets
+a tree integrity sidecar (``<step>.integrity.json``, per-file SHA-256)
+written at :meth:`Checkpointer.wait`, and :meth:`Checkpointer.restore`
+verifies before orbax ever deserializes. A step with POSITIVE corruption
+evidence — missing ``_CHECKPOINT_METADATA`` (torn save), a sidecar whose
+hashes disagree (bit flip/truncation), or an unreadable sidecar — is
+quarantined aside and restore walks back to
+the previous retained step or ``best/``, so ``--resume`` after a torn
+``last/`` is automatic instead of a crash. A step with no sidecar at all
+(legacy root, or a kill between orbax finalize and our sidecar write) is
+merely *unverified*: it ranks below every verified candidate in the walk
+but is still restorable with a logged warning — quarantining a healthy
+finalized save would be worse than restoring it.
+
+Multi-host: only the primary host constructs a Checkpointer
+(training/loop.py), so the fallback decision — which step actually
+restored — is made on host 0 alone and reaches every other host through
+the existing resume broadcast (start_epoch + state tree), the same
+discipline as the PR-4 tuning-store read. Hosts can never walk back to
+different steps.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import orbax.checkpoint as ocp
+
+from deepinteract_tpu.robustness import artifacts, faults
+
+logger = logging.getLogger(__name__)
+
+# Schema kind of the per-step tree sidecars (artifact-integrity/v1) —
+# shared with cli/fsck.py via the artifacts module so both paths count
+# the same corruption class under one di_artifact_corrupt_total label.
+CHECKPOINT_KIND = artifacts.CHECKPOINT_KIND
+
+# Written by orbax at step finalize; its absence in a step directory is
+# positive evidence of a torn save (kill -9 mid-commit).
+_ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
 
 
 def _partial_restore_args(target: Any):
@@ -53,6 +88,12 @@ class Checkpointer:
 
     def __init__(self, cfg: CheckpointConfig):
         self.cfg = cfg
+        # What the last restore() actually loaded — (which, step). The
+        # fallback walk can land on an OLDER step than latest_step(), and
+        # resume bookkeeping (training/loop.py start_epoch) must follow
+        # the restored state, not the quarantined directory listing.
+        self.last_restored_step: Optional[int] = None
+        self.last_restored_which: Optional[str] = None
         mode = metric_mode(cfg.metric_to_track)
         sign = 1.0 if mode == "max" else -1.0
 
@@ -99,6 +140,15 @@ class Checkpointer:
             if cfg.keep_last
             else None
         )
+        # Startup sweep: orphaned sidecar tmps from a killed run. The
+        # orbax payloads themselves commit via directory rename, so only
+        # OUR ``*.integrity.json.<pid>.tmp`` strays can linger here —
+        # and the filters matter: the ckpt root is SHARED (the tuning
+        # store and trainer_state.json live here), so an unscoped sweep
+        # could reap a concurrent cli.tune's live tmp.
+        artifacts.sweep_tmp(root, prefix="trainer_state.json")
+        for d in (os.path.join(root, "best"), os.path.join(root, "last")):
+            artifacts.sweep_tmp(d, contains=artifacts.SIDECAR_SUFFIX + ".")
 
     def save(self, step: int, state: Any, metrics: dict) -> None:
         clean = {
@@ -114,6 +164,87 @@ class Checkpointer:
         self.best.wait_until_finished()
         if self.last is not None:
             self.last.wait_until_finished()
+        self._finalize_integrity()
+
+    # -- integrity ---------------------------------------------------------
+
+    def _managers(self) -> List[Tuple[Any, str]]:
+        out: List[Tuple[Any, str]] = [(self.best, "best")]
+        if self.last is not None:
+            out.append((self.last, "last"))
+        return out
+
+    @staticmethod
+    def _steps(mgr) -> List[int]:
+        try:
+            return [int(s) for s in mgr.all_steps()]
+        except OSError:  # a root that vanished mid-run: nothing retained
+            return []
+
+    def _finalize_integrity(self) -> None:
+        """Write tree sidecars for retained steps that lack one, and drop
+        sidecars orphaned by orbax retention (max_to_keep deletions). A
+        finalized step directory never changes, so an existing sidecar is
+        never rewritten."""
+        for mgr, name in self._managers():
+            root = str(mgr.directory)
+            for step in self._steps(mgr):
+                step_dir = os.path.join(root, str(step))
+                if not os.path.isdir(step_dir):
+                    continue
+                if os.path.exists(artifacts.sidecar_path(step_dir)):
+                    continue
+                try:
+                    artifacts.write_tree_sidecar(
+                        step_dir, CHECKPOINT_KIND,
+                        extra={"step": int(step), "which": name})
+                except OSError as exc:
+                    # A full disk must not turn a finished save into a
+                    # crash; the step just stays unverified.
+                    logger.warning("could not write integrity sidecar for "
+                                   "%s: %s", step_dir, exc)
+            try:
+                names = os.listdir(root)
+            except OSError:
+                continue
+            for nm in names:
+                if not nm.endswith(artifacts.SIDECAR_SUFFIX):
+                    continue
+                target = nm[: -len(artifacts.SIDECAR_SUFFIX)]
+                if not os.path.exists(os.path.join(root, target)):
+                    try:
+                        os.unlink(os.path.join(root, nm))
+                    except OSError:
+                        pass
+
+    @staticmethod
+    def _quarantine_step(mgr, step_dir: str, reason: str) -> None:
+        """Quarantine a step dir AND refresh the owning manager's cached
+        step metadata — orbax caches the directory listing, and a later
+        save's retention pass would otherwise look up the moved step and
+        crash."""
+        artifacts.quarantine(step_dir, CHECKPOINT_KIND, reason)
+        try:
+            mgr.reload()
+        except (AttributeError, OSError):  # older orbax / racing listing
+            pass
+
+    def _verify_step(self, step_dir: str) -> str:
+        """'verified' | 'unverified' (no sidecar — legacy/kill-between-
+        finalize-and-sidecar), or raises CorruptArtifact/StaleArtifact on
+        positive corruption evidence."""
+        if faults.fire("checkpoint.restore"):
+            raise artifacts.CorruptArtifact(
+                step_dir, "injected checkpoint.restore fault")
+        if not os.path.isdir(step_dir):
+            raise FileNotFoundError(step_dir)
+        if not os.path.exists(os.path.join(step_dir, _ORBAX_COMMIT_MARKER)):
+            raise artifacts.CorruptArtifact(
+                step_dir, f"torn save: {_ORBAX_COMMIT_MARKER} missing "
+                          "(killed mid-commit)")
+        manifest = artifacts.verify_tree(
+            step_dir, kind=CHECKPOINT_KIND, require_sidecar=False)
+        return "verified" if manifest is not None else "unverified"
 
     def best_step(self) -> Optional[int]:
         return self.best.best_step()
@@ -123,6 +254,34 @@ class Checkpointer:
             return self.last.latest_step()
         return self.best.latest_step()
 
+    def _restore_candidates(self, which: str) -> List[Tuple[Any, str, int]]:
+        """(manager, name, step) in walk-back preference order: the
+        requested root newest-first, then the sibling root newest-first —
+        except that ``which='best'`` leads with the metric-best step."""
+        out: List[Tuple[Any, str, int]] = []
+        if which == "last" and self.last is not None:
+            for s in sorted(self._steps(self.last), reverse=True):
+                out.append((self.last, "last", s))
+            for s in sorted(self._steps(self.best), reverse=True):
+                out.append((self.best, "best", s))
+            return out
+        steps = sorted(self._steps(self.best), reverse=True)
+        top = self.best.best_step()
+        if top is not None and top in steps:
+            steps.remove(top)
+            steps.insert(0, top)
+        for s in steps:
+            out.append((self.best, "best", s))
+        if self.last is not None:
+            for s in sorted(self._steps(self.last), reverse=True):
+                out.append((self.last, "last", s))
+        return out
+
+    def _orbax_restore(self, mgr, step: int, target: Any, partial: bool):
+        if partial:
+            return mgr.restore(step, args=_partial_restore_args(target))
+        return mgr.restore(step, args=ocp.args.StandardRestore(target))
+
     def restore(
         self, target: Any, step: Optional[int] = None, which: str = "best",
         partial: bool = False,
@@ -130,15 +289,83 @@ class Checkpointer:
         """Restore into the structure of ``target`` (an abstract or concrete
         state pytree). ``partial=True`` restores only the keys present in
         ``target`` (e.g. params/batch_stats for fine-tune warm starts whose
-        optimizer structure differs from the saved one)."""
+        optimizer structure differs from the saved one).
+
+        Every step is integrity-verified before orbax deserializes it.
+        With ``step=None`` a corrupt candidate is quarantined and the walk
+        falls back to the previous retained step or the sibling root
+        (last-good fallback; the one-line log names what was skipped).
+        Verified steps are always preferred over sidecar-less ones. An
+        EXPLICIT ``step`` disables the walk: the caller asked for that
+        state and nothing else, so corruption raises
+        :class:`~deepinteract_tpu.robustness.artifacts.CorruptArtifact`
+        after quarantining it. Restored-step identity is decided on the
+        host that owns this Checkpointer (host 0 in multi-host runs) and
+        reaches the others via the resume broadcast in training/loop.py.
+        """
         mgr = self.best if which == "best" or self.last is None else self.last
-        if step is None:
-            step = mgr.best_step() if mgr is self.best and which == "best" else mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint found under {self.cfg.directory} ({which})")
-        if partial:
-            return mgr.restore(step, args=_partial_restore_args(target))
-        return mgr.restore(step, args=ocp.args.StandardRestore(target))
+        if step is not None:
+            step_dir = os.path.join(str(mgr.directory), str(step))
+            try:
+                self._verify_step(step_dir)
+            except (artifacts.CorruptArtifact, artifacts.StaleArtifact) as exc:
+                self._quarantine_step(mgr, step_dir, exc.reason)
+                raise artifacts.CorruptArtifact(
+                    step_dir, f"requested step {step} is corrupt "
+                              f"({exc.reason}); quarantined")
+            state = self._orbax_restore(mgr, step, target, partial)
+            self.last_restored_step = int(step)
+            self.last_restored_which = which
+            return state
+
+        unverified: List[Tuple[Any, str, int, str]] = []
+        requested = None
+        for mgr_i, name, s in self._restore_candidates(which):
+            step_dir = os.path.join(str(mgr_i.directory), str(s))
+            if requested is None:
+                requested = (name, s)
+            try:
+                status = self._verify_step(step_dir)
+            except FileNotFoundError:
+                continue
+            except (artifacts.CorruptArtifact, artifacts.StaleArtifact) as exc:
+                self._quarantine_step(mgr_i, step_dir, exc.reason)
+                continue
+            if status == "unverified":
+                unverified.append((mgr_i, name, s, step_dir))
+                continue
+            return self._attempt(mgr_i, name, s, step_dir, target,
+                                 partial, requested)
+        for mgr_i, name, s, step_dir in unverified:
+            logger.warning("restoring UNVERIFIED checkpoint %s (no "
+                           "integrity sidecar — pre-integrity save?)",
+                           step_dir)
+            return self._attempt(mgr_i, name, s, step_dir, target,
+                                 partial, requested)
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.cfg.directory} "
+            f"({which}): every retained step was missing or corrupt "
+            "(quarantined — see *.corrupt-* aside)")
+
+    def _attempt(self, mgr, name: str, step: int, step_dir: str,
+                 target: Any, partial: bool, requested) -> Any:
+        """One orbax restore. An orbax exception here PROPAGATES: the
+        step's bytes already passed (or had no) integrity checks, so a
+        deserialize failure means the CALLER's target tree doesn't match
+        the saved one (changed optimizer/model config) or an orbax bug —
+        quarantining on it would empty the whole checkpoint root one
+        healthy step at a time, since every candidate fails the same way
+        against the same target. Only positive on-disk corruption
+        evidence quarantines (_verify_step)."""
+        state = self._orbax_restore(mgr, step, target, partial)
+        if requested is not None and requested != (name, step):
+            logger.warning(
+                "checkpoint fallback: restored %s/%s instead of the "
+                "newest candidate %s/%s (corrupt/unrestorable steps "
+                "quarantined along the walk)", name, step, *requested)
+        self.last_restored_step = int(step)
+        self.last_restored_which = name
+        return state
 
     def close(self) -> None:
         self.wait()

@@ -42,7 +42,7 @@ from deepinteract_tpu.parallel.multihost import (
     host_local_array,
     is_primary_host,
 )
-from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.robustness import artifacts, faults
 from deepinteract_tpu.robustness.guards import (
     NonFiniteTrainingError,
     dump_diagnostics,
@@ -585,7 +585,14 @@ class Trainer:
             if ckpt is not None and ckpt.latest_step() is not None:
                 state = _restore_into(
                     state, ckpt.restore(state_template(state), which="last"))
-                start_epoch = int(ckpt.latest_step())
+                # The step the restore ACTUALLY loaded: the last-good
+                # fallback (training/checkpoint.py) may have quarantined
+                # a corrupt newest step and walked back, and the epoch
+                # counter must follow the restored state, not the
+                # pre-quarantine directory listing.
+                restored_step = ckpt.last_restored_step
+                start_epoch = int(restored_step if restored_step is not None
+                                  else ckpt.latest_step())
                 # EarlyStopping bookkeeping rides a JSON sidecar next to
                 # the orbax roots: a preemption-resume must not reset
                 # patience/best, or the resumed run would stop later than
@@ -1462,21 +1469,31 @@ def _sidecar_path(ckpt_dir: str) -> str:
 
 def _write_sidecar(ckpt_dir: str, payload: Dict[str, Any]) -> None:
     """Persist loop-level bookkeeping (EarlyStopping best/patience) that
-    lives outside the TrainState pytree — atomic tmp+rename so a
-    preemption mid-write leaves the previous epoch's sidecar intact.
-    ``json`` round-trips ±inf (the fresh-stopper ``best``) natively."""
-    path = _sidecar_path(ckpt_dir)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)
+    lives outside the TrainState pytree — atomic write + integrity
+    sidecar (robustness/artifacts.py) so a preemption mid-write leaves
+    the previous epoch's intact and a later resume can verify what it
+    adopts. ``json`` round-trips ±inf (the fresh-stopper ``best``)
+    natively."""
+    artifacts.atomic_write_artifact(
+        _sidecar_path(ckpt_dir), json.dumps(payload), "trainer-state")
 
 
 def _read_sidecar(ckpt_dir: str) -> Optional[Dict[str, Any]]:
+    """None when absent OR corrupt — the orbax step counter is the source
+    of truth and a resume without stopper bookkeeping merely resets
+    patience (recoverable); a corrupt file is quarantined so the loss is
+    loud, counted, and auditable, never silent."""
+    path = _sidecar_path(ckpt_dir)
+    if not os.path.exists(path):
+        return None
     try:
-        with open(_sidecar_path(ckpt_dir)) as f:
-            return json.load(f)
-    except (OSError, ValueError):
+        raw = artifacts.verify_read(path, kind="trainer-state",
+                                    require_sidecar=False)
+        return json.loads(raw.decode("utf-8"))
+    except (artifacts.ArtifactError, UnicodeDecodeError, ValueError) as exc:
+        artifacts.quarantine(path, "trainer-state", str(exc))
+        return None
+    except OSError:
         return None
 
 

@@ -35,6 +35,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from deepinteract_tpu.robustness import artifacts
+
 
 def top_k_prec(sorted_indices: np.ndarray, labels: np.ndarray, k: int) -> float:
     """Reference ``calculate_top_k_prec`` (deepinteract_utils.py:977-984).
@@ -176,15 +178,15 @@ def write_topk_csv(
 ) -> None:
     """Per-target CSV matching the reference's ``*_top_metrics.csv``
     (deepinteract_modules.py:2130-2145): pandas-style with an index column."""
-    with open(path, "w") as f:
-        f.write("," + ",".join(TOPK_CSV_COLUMNS) + "\n")
-        for i, (metrics, target) in enumerate(zip(per_complex, targets)):
-            row = [str(i)]
-            for col in TOPK_CSV_COLUMNS[:-1]:
-                v = metrics.get(col, float("nan"))
-                row.append(repr(v) if not math.isnan(v) else "")
-            row.append(str(target))
-            f.write(",".join(row) + "\n")
+    lines = ["," + ",".join(TOPK_CSV_COLUMNS)]
+    for i, (metrics, target) in enumerate(zip(per_complex, targets)):
+        row = [str(i)]
+        for col in TOPK_CSV_COLUMNS[:-1]:
+            v = metrics.get(col, float("nan"))
+            row.append(repr(v) if not math.isnan(v) else "")
+        row.append(str(target))
+        lines.append(",".join(row))
+    artifacts.atomic_write(path, "\n".join(lines) + "\n")
 
 
 def gather_pair_predictions(probs: np.ndarray, examples: np.ndarray, example_mask: np.ndarray):

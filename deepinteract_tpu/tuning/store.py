@@ -45,11 +45,17 @@ Key design points, each earned the hard way:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Dict, Optional
 
+from deepinteract_tpu.robustness import artifacts
 from deepinteract_tpu.tuning.space import TrialConfig
+
+logger = logging.getLogger(__name__)
+
+STORE_KIND = "tuning-store"
 
 # 2 (r6): model_signature dropped its compute_dtype suffix when the dtype
 # became a tunable knob (tuning/space.py) — entry keys changed format, so
@@ -95,9 +101,18 @@ class TuningStore:
     @classmethod
     def load(cls, path: str) -> "TuningStore":
         """Read an existing store; raises StoreSchemaError on a version
-        mismatch and OSError/ValueError on a missing/corrupt file."""
-        with open(path) as fh:
-            data = json.load(fh)
+        mismatch, :class:`~deepinteract_tpu.robustness.artifacts.
+        CorruptArtifact` when the bytes fail their integrity sidecar (or
+        verified bytes fail to parse), and OSError on a missing file. A
+        sidecar-less store from an older run loads unverified (its JSON
+        parse errors are still surfaced as CorruptArtifact so every
+        caller handles ONE corruption type)."""
+        raw = artifacts.verify_read(path, kind=STORE_KIND,
+                                    require_sidecar=False)
+        try:
+            data = json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as exc:
+            raise artifacts.CorruptArtifact(path, f"not JSON: {exc}")
         return cls._from_payload(path, data)
 
     @classmethod
@@ -116,29 +131,61 @@ class TuningStore:
 
     @classmethod
     def load_or_create(cls, path: str) -> "TuningStore":
+        """A corrupt store is quarantined and the search RESTARTS from an
+        empty store (re-measuring costs minutes; adopting garbage knobs
+        silently regresses every consumer). Schema mismatches still raise
+        — they mean the caller must re-tune deliberately, not blindly."""
+        directory = os.path.dirname(os.path.abspath(path))
+        artifacts.sweep_tmp(directory, prefix=os.path.basename(path))
         if os.path.exists(path):
-            return cls.load(path)
+            try:
+                return cls.load(path)
+            except artifacts.CorruptArtifact as exc:
+                artifacts.quarantine(path, STORE_KIND, str(exc))
+                logger.error("tuning store %s was corrupt; restarting the "
+                             "search from an empty store", path)
         return cls(path)
 
     @classmethod
     def load_replicated(cls, path: str) -> Optional["TuningStore"]:
-        """Multi-host-safe read: process 0 reads (or fails) and broadcasts
-        the bytes; every host parses the SAME payload. Returns None when
-        the store does not exist on host 0 (on every host). Schema errors
-        still raise — on all hosts, identically."""
+        """Multi-host-safe read: process 0 reads AND integrity-verifies
+        (or fails) and broadcasts the bytes; every host parses the SAME
+        payload. Returns None when the store does not exist on host 0 —
+        or was corrupt there, in which case host 0 quarantines it and
+        every host identically degrades to untuned defaults (the
+        broadcast of the fallback decision, not the broken bytes).
+        Schema errors still raise — on all hosts, identically."""
         import jax
 
         if jax.process_count() <= 1:
             if not os.path.exists(path):
                 return None
-            return cls.load(path)
+            try:
+                return cls.load(path)
+            except artifacts.CorruptArtifact as exc:
+                artifacts.quarantine(path, STORE_KIND, str(exc))
+                logger.error("tuning store %s was corrupt; consumers fall "
+                             "back to untuned defaults", path)
+                return None
         import numpy as np
         from jax.experimental import multihost_utils
 
         raw = b""
         if jax.process_index() == 0 and os.path.exists(path):
-            with open(path, "rb") as fh:
-                raw = fh.read()
+            try:
+                raw = artifacts.verify_read(path, kind=STORE_KIND,
+                                            require_sidecar=False)
+                # Sidecar-less legacy bytes pass verify_read unverified —
+                # parse-check them HERE, before the broadcast, so a torn
+                # legacy store degrades on every host (empty broadcast)
+                # instead of crashing them all in the shared json.loads.
+                json.loads(raw.decode("utf-8"))
+            except (artifacts.ArtifactError, UnicodeDecodeError,
+                    ValueError) as exc:
+                artifacts.quarantine(path, STORE_KIND, str(exc))
+                logger.error("tuning store %s was corrupt on host 0; every "
+                             "host falls back to untuned defaults", path)
+                raw = b""
         # Length-prefixed fixed-width broadcast (broadcast_one_to_all needs
         # same-shape arrays on every host).
         n = multihost_utils.broadcast_one_to_all(
@@ -155,15 +202,14 @@ class TuningStore:
         return cls._from_payload(path, data)
 
     def save(self) -> None:
-        """Atomic whole-file rewrite (tmp + rename): a kill mid-save
-        leaves the previous version intact, never a torn file."""
-        directory = os.path.dirname(self.path)
-        if directory:
-            os.makedirs(directory, exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self.data, fh, indent=1, sort_keys=True)
-        os.replace(tmp, self.path)
+        """Atomic whole-file rewrite + integrity sidecar
+        (robustness/artifacts.py): a kill mid-save leaves the previous
+        version intact — never a torn file — and a later reader can
+        verify the bytes before adopting any knob."""
+        artifacts.atomic_write_artifact(
+            self.path,
+            json.dumps(self.data, indent=1, sort_keys=True),
+            STORE_KIND, version=SCHEMA_VERSION)
 
     # -- entries -----------------------------------------------------------
 

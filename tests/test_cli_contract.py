@@ -72,7 +72,7 @@ def test_registered_kinds_cover_every_contract_cli():
     whose final line is a machine contract has a registered kind, so a
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
-            "perf_regression", "lint"} <= set(CONTRACTS)
+            "perf_regression", "lint", "fsck"} <= set(CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
 
@@ -158,6 +158,27 @@ def test_lint_kind_matches_real_cli_emission(tmp_path, capsys):
     assert rec["schema"] == "lint/v1"
     assert rec["ok"] is True and rec["findings_new"] == 0
     assert "lock-discipline" in rec["rules"]
+
+
+def test_fsck_kind_matches_real_cli_emission(tmp_path, capsys):
+    """The fsck/v1 contract is validated against the REAL cli.fsck run
+    over a tiny run dir holding one verified artifact and one injected
+    corruption (pure file work — no device, no compile)."""
+    from deepinteract_tpu.cli.fsck import main
+    from deepinteract_tpu.robustness import artifacts
+
+    good = tmp_path / "store.json"
+    artifacts.atomic_write_artifact(str(good), b'{"ok": true}', "demo")
+    bad = tmp_path / "manifest.json"
+    artifacts.atomic_write_artifact(str(bad), b'{"v": 1}', "demo")
+    bad.write_bytes(b'{"v": 2}')  # bit-flip class: bytes != sidecar
+    rc = main([str(tmp_path)])
+    assert rc == 1
+    rec = check_cli_contract_text(capsys.readouterr().out, "fsck")
+    assert rec["schema"] == "fsck/v1"
+    assert rec["ok"] is False and rec["corrupt"] == 1
+    assert rec["verified"] == 1
+    assert rec["corrupt_paths"] == [str(bad)]
 
 
 def test_cli_main_entry(tmp_path, capsys):

@@ -53,10 +53,10 @@ def test_repo_wide_lint_passes_against_baseline(capsys):
     assert rec["ok"] is True
     assert rec["findings_new"] == 0
     assert rec["parse_failures"] == 0
-    # All six rules ran in the one process.
+    # All seven rules ran in the one process.
     assert set(rec["rules"]) == {
         "no-print", "dtype-discipline", "jit-host-sync", "lock-discipline",
-        "prng-key-reuse", "dead-cli-flag"}
+        "prng-key-reuse", "dead-cli-flag", "artifact-write"}
     assert rec["files_scanned"] > 100
 
 
@@ -91,7 +91,12 @@ def test_repo_wide_suppressions_are_intentional(capsys):
     main([])
     rec = json.loads(
         [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
-    assert rec["suppressed"] <= 15, (
+    # 17 = 10 pre-ISSUE-12 pragmas + 7 artifact-write waivers (streaming
+    # sinks whose readers tolerate a torn tail, transient/regenerable
+    # offline build outputs, and the download fetch whose atomicity is
+    # the verified move) — every other write-mode open() was converted
+    # to robustness/artifacts.atomic_write.
+    assert rec["suppressed"] <= 17, (
         "suppression count grew — justify or fix the new ones")
 
 
@@ -189,6 +194,46 @@ def test_baseline_schema_mismatch_fails_loudly(tmp_path):
 
 
 # -- rule fixtures: each fires AND respects suppression -------------------
+
+
+def test_artifact_write_fires_and_suppresses(tmp_path):
+    write_tree(tmp_path, {
+        "deepinteract_tpu/io.py": (
+            "def f(path, m):\n"
+            "    open(path)\n"                      # read: clean
+            "    open(path, 'rb')\n"                # read: clean
+            "    open(path, 'w')\n"                 # fires
+            "    open(path, mode='ab')\n"           # fires (append kwarg)
+            "    open(path, 'x')\n"                 # fires (exclusive)
+            "    open(path, 'r+')\n"                # fires (update)
+            "    open(path, m)\n"                   # dynamic: undecidable
+            "    path.open('w')\n"                  # method, not builtin
+            "    # di: allow[artifact-write] streaming sink demo\n"
+            "    open(path, 'a')\n"),
+        "deepinteract_tpu/robustness/artifacts.py": (
+            "def atomic_write(path, data):\n"
+            "    with open(path, 'wb') as f:\n"
+            "        f.write(data)\n"),
+        "tools/script.py": "open('out.txt', 'w')\n",  # out of package scope
+    })
+    r = findings_of(tmp_path, "artifact-write")
+    assert [(f.path, f.line) for f in r.findings] == [
+        ("deepinteract_tpu/io.py", 4),
+        ("deepinteract_tpu/io.py", 5),
+        ("deepinteract_tpu/io.py", 6),
+        ("deepinteract_tpu/io.py", 7),
+    ]
+    assert [(f.path, f.line) for f in r.suppressed] == [
+        ("deepinteract_tpu/io.py", 11)]
+
+
+def test_artifact_write_repo_is_clean():
+    """ISSUE-12 satellite: every write-mode open() in the package either
+    goes through robustness/artifacts.py or carries a reasoned waiver —
+    the committed baseline stays empty on this rule."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    r = findings_of(repo, "artifact-write")
+    assert [(f.path, f.line) for f in r.findings] == []
 
 
 def test_no_print_fires_and_suppresses(tmp_path):

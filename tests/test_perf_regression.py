@@ -60,14 +60,50 @@ def test_recover_contract_from_all_artifact_shapes(tmp_path):
 
 def test_resolve_baseline_prefers_blessed_then_newest_bench(tmp_path):
     _write_trajectory(tmp_path)
-    contract, path = resolve_baseline(root=str(tmp_path))
+    contract, path, notes = resolve_baseline(root=str(tmp_path))
     assert path.endswith("BENCH_r02.json") and contract["value"] == 33.0
+    assert notes == []
     (tmp_path / "PERF_BASELINE.json").write_text(
         json.dumps(dict(GOOD, value=31.0)))
-    contract, path = resolve_baseline(root=str(tmp_path))
+    contract, path, notes = resolve_baseline(root=str(tmp_path))
     assert path.endswith("PERF_BASELINE.json") and contract["value"] == 31.0
+    assert notes == []
     with pytest.raises(FileNotFoundError, match="no usable baseline"):
         resolve_baseline(root=str(tmp_path / "empty"))
+
+
+def test_corrupt_blessed_baseline_degrades_to_trajectory(tmp_path, capsys):
+    """ISSUE-12 satellite: a truncated/corrupt PERF_BASELINE.json must
+    not crash the gate — it degrades to the newest recoverable BENCH_r
+    artifact with a loud note riding the final contract line."""
+    _write_trajectory(tmp_path)
+    # Truncated mid-JSON — the torn-bless crash class.
+    (tmp_path / "PERF_BASELINE.json").write_text(
+        json.dumps(GOOD)[:37])
+    contract, path, notes = resolve_baseline(root=str(tmp_path))
+    assert path.endswith("BENCH_r02.json") and contract["value"] == 33.0
+    assert len(notes) == 1 and "BASELINE DEGRADED" in notes[0]
+
+    # End-to-end through main: exit 0 on matching numbers, note present,
+    # degraded flag set, contract line still parses as the registered
+    # kind.
+    import tools.check_perf_regression as cpr
+    from tools.check_cli_contract import check_cli_contract_text
+
+    fresh = tmp_path / "fresh.log"
+    fresh.write_text(_capture(GOOD))
+    old_root = cpr.REPO_ROOT
+    cpr.REPO_ROOT = str(tmp_path)
+    try:
+        rc = main(["--fresh", str(fresh)])
+    finally:
+        cpr.REPO_ROOT = old_root
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out,
+                                  "perf_regression")
+    assert rec["ok"] is True
+    assert rec["baseline_degraded"] is True
+    assert any("BASELINE DEGRADED" in n for n in rec["notes"])
 
 
 def test_compare_tolerances_and_directions():
@@ -197,7 +233,7 @@ def test_blessed_repo_baseline_parses_and_covers_perf_keys():
     assert len(gating) >= 3, f"blessed baseline gates too little: {gating}"
     assert flat["value"] > 0 and flat["vs_baseline"] > 0
     # And the repo-level resolution order actually picks it up.
-    _, path = resolve_baseline()
+    _, path, _notes = resolve_baseline()
     assert path.endswith("PERF_BASELINE.json")
 
 

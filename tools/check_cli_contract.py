@@ -66,6 +66,15 @@ CONTRACTS = {
         "numeric": ("value", "files_scanned", "findings_total",
                     "findings_new", "findings_baselined", "suppressed"),
     },
+    # fsck/v1: python -m deepinteract_tpu.cli.fsck (durable-artifact
+    # verify/quarantine/report; robustness/artifacts.py).
+    "fsck": {
+        "required": ("schema", "metric", "value", "unit", "ok", "root",
+                     "scanned", "verified", "unverified", "corrupt",
+                     "quarantined", "tmp_files", "corrupt_paths"),
+        "numeric": ("value", "scanned", "verified", "unverified",
+                    "corrupt", "quarantined", "tmp_files"),
+    },
 }
 
 

@@ -126,13 +126,27 @@ def recover_contract(path: str) -> dict:
 
 
 def resolve_baseline(explicit: str = "", root: str = ""):
-    """(contract, path) per the resolution order in the module doc."""
+    """(contract, path, notes) per the resolution order in the module
+    doc. A corrupt/truncated blessed ``PERF_BASELINE.json`` DEGRADES to
+    trajectory recovery (newest recoverable ``BENCH_r*.json``) with a
+    loud note that rides into the final contract line — the gate keeps
+    gating instead of crashing on a torn bless (the durable-artifacts
+    discipline, ISSUE-12). An explicit ``--baseline`` still raises: the
+    operator asked for THAT file."""
     root = root or REPO_ROOT  # read at call time (tests repoint it)
+    notes = []
     if explicit:
-        return recover_contract(explicit), explicit
+        return recover_contract(explicit), explicit, notes
     blessed = os.path.join(root, BLESSED_BASENAME)
     if os.path.exists(blessed):
-        return recover_contract(blessed), blessed
+        try:
+            return recover_contract(blessed), blessed, notes
+        except (ValueError, json.JSONDecodeError) as exc:
+            notes.append(
+                f"BASELINE DEGRADED: blessed {BLESSED_BASENAME} is "
+                f"corrupt/unreadable ({exc}) — falling back to the "
+                "BENCH_r trajectory; re-bless with --update")
+            print(f"WARNING: {notes[-1]}", file=sys.stderr)
     candidates = sorted(
         glob.glob(os.path.join(root, "BENCH_r*.json")),
         key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)),
@@ -140,11 +154,11 @@ def resolve_baseline(explicit: str = "", root: str = ""):
     errors = []
     for path in candidates:
         try:
-            return recover_contract(path), path
+            return recover_contract(path), path, notes
         except (ValueError, json.JSONDecodeError) as exc:
             errors.append(f"{os.path.basename(path)}: {exc}")
     raise FileNotFoundError(
-        "no usable baseline: no --baseline, no "
+        "no usable baseline: no --baseline, no readable "
         f"{BLESSED_BASENAME}, and no BENCH_r*.json with a recoverable "
         f"contract ({'; '.join(errors) or 'none found'})")
 
@@ -267,12 +281,14 @@ def main(argv=None) -> int:
         return 0
 
     try:
-        baseline, baseline_path = resolve_baseline(args.baseline)
+        baseline, baseline_path, resolve_notes = resolve_baseline(
+            args.baseline)
     except (FileNotFoundError, ValueError) as exc:
         print(f"PERF REGRESSION CHECK FAILED: {exc}", file=sys.stderr)
         return 2
 
     verdict = compare(fresh, baseline)
+    verdict["notes"] = resolve_notes + verdict["notes"]
     for reg in verdict["regressions"]:
         print(f"REGRESSION [{reg['kind']}] {reg['key']}: "
               f"{reg.get('baseline')} -> {reg.get('fresh')} "
@@ -291,6 +307,7 @@ def main(argv=None) -> int:
         "regressions": verdict["regressions"],
         "improvements": verdict["improvements"],
         "notes": verdict["notes"],
+        "baseline_degraded": bool(resolve_notes),
     }))
     return 0 if verdict["ok"] else 1
 
