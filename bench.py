@@ -100,6 +100,7 @@ SECTION_EST_S = {
     "b1_p128_deeplab": 300,
     "screening": 300,
     "saturation": 240,
+    "rollover": 180,
     "attribution": 240,
 }
 
@@ -579,7 +580,7 @@ def _section_names(platform: str) -> list:
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
              "b1_p256", "b1_p384_tiled", "eval_path", "screening",
-             "saturation", "attribution"]
+             "saturation", "rollover", "attribution"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -1137,6 +1138,15 @@ def _run_screening_section(ctx, detail) -> None:
     _dump_partial(detail)
 
 
+def _nearest_rank(sorted_samples, q):
+    """Nearest-rank percentile over PRE-SORTED samples — the one
+    definition behind the gated saturation/rollover p99 keys (two
+    sections drifting on quantile convention would make their gated
+    ratios incomparable)."""
+    return sorted_samples[min(len(sorted_samples) - 1,
+                              int(q * len(sorted_samples)))]
+
+
 def _run_saturation_section(ctx, detail) -> None:
     """Overload behavior under deliberate oversubscription (ISSUE-11):
     bounded admission queues + request deadlines + 429/Retry-After
@@ -1217,8 +1227,7 @@ def _run_saturation_section(ctx, detail) -> None:
         unsat_s = time.perf_counter() - t0
         unsat_lat.sort()
         unsat_p50 = unsat_lat[len(unsat_lat) // 2]
-        unsat_p99 = unsat_lat[min(len(unsat_lat) - 1,
-                                  int(0.99 * len(unsat_lat)))]
+        unsat_p99 = _nearest_rank(unsat_lat, 0.99)
         unsat_rps = len(unsat_lat) / unsat_s
         entry["unsat_p50_ms"] = round(unsat_p50 * 1e3, 2)
         entry["unsat_p99_ms"] = round(unsat_p99 * 1e3, 2)
@@ -1291,7 +1300,7 @@ def _run_saturation_section(ctx, detail) -> None:
                 sorted(rejected)[len(rejected) // 2], 3)
         if served:
             p50 = served_lat[served // 2]
-            p99 = served_lat[min(served - 1, int(0.99 * served))]
+            p99 = _nearest_rank(served_lat, 0.99)
             entry["served_p50_ms"] = round(p50 * 1e3, 2)
             entry["served_p99_ms"] = round(p99 * 1e3, 2)
             entry["served_per_sec"] = round(served / duration_s, 3)
@@ -1308,6 +1317,202 @@ def _run_saturation_section(ctx, detail) -> None:
         k: entry.get(k) for k in (
             "served", "rejected", "deadline_expired", "served_p99_ms",
             "unsat_p99_ms", "p99_ratio", "served_per_sec", "reject_rate")}}))
+    _dump_partial(detail)
+
+
+def _run_rollover_section(ctx, detail) -> None:
+    """Latency disruption of a LIVE warm rollover (ISSUE-13): steady
+    closed-loop load through the fleet router while ``POST
+    /admin/rollover`` replaces every worker, measured end to end.
+
+    The fleet runs ``serving/worker_stub.py`` null-engine workers with a
+    fixed simulated device latency, so the measured numbers isolate the
+    FLEET LAYER's contribution — routing-table swap, failover retries in
+    the drain race window, replacement warm-wait — which is exactly what
+    the zero-downtime contract is about (an engine worker's own latency
+    is covered by the other sections). The contract keys:
+    ``dropped_requests`` (non-200 answers during the rollover window;
+    the bar is ZERO) and ``p99_during_rollover_ms`` vs the steady-state
+    p99 measured through the SAME router (the bar is <= 2x)."""
+    import tempfile
+    import threading as _threading
+
+    from deepinteract_tpu.serving.fleet import (
+        FleetConfig,
+        WorkerSupervisor,
+        request_json,
+        stub_worker_cmd,
+    )
+    from deepinteract_tpu.serving.router import FleetRouter, RouterConfig
+
+    workers = int(os.environ.get("DI_BENCH_ROLLOVER_WORKERS", "2"))
+    clients = int(os.environ.get("DI_BENCH_ROLLOVER_CLIENTS", "4"))
+    steady_s = float(os.environ.get("DI_BENCH_ROLLOVER_STEADY", "3"))
+    load_s = float(os.environ.get("DI_BENCH_ROLLOVER_SECONDS", "8"))
+    delay_ms = 20.0
+    state_dir = tempfile.mkdtemp(prefix="di_bench_fleet_")
+    supervisor = WorkerSupervisor(
+        stub_worker_cmd,
+        FleetConfig(num_workers=workers, probe_interval_s=0.2,
+                    heartbeat_max_age_s=5.0, state_dir=state_dir),
+        overrides={"weights_signature": "bench-v1",
+                   "delay_ms": delay_ms, "warm_buckets": "64x64/b1",
+                   "heartbeat_interval_s": 0.2})
+    router = FleetRouter(
+        supervisor, port=0,
+        cfg=RouterConfig(proxy_timeout_s=10.0, warm_timeout_s=60.0,
+                         drain_timeout_s=30.0,
+                         required_warm_buckets=("64x64/",)))
+    entry = {"workers": workers, "clients": clients,
+             "stub_delay_ms": delay_ms, "load_s": load_s,
+             "protocol": "closed-loop clients through the router over "
+                         "stub workers; rollover mid-window"}
+    detail["rollover"] = entry
+    try:
+        router.start()
+        host, port = router.address
+        warm_deadline = time.monotonic() + 60.0
+        while (len(supervisor.routable_workers()) < workers
+               and time.monotonic() < warm_deadline):
+            supervisor.poll_once()
+            time.sleep(0.05)
+        if len(supervisor.routable_workers()) < workers:
+            raise RuntimeError("fleet never became fully routable")
+
+        lock = _threading.Lock()
+
+        def post_predict():
+            return request_json(host, port, "POST", "/predict",
+                                body=b"{}", timeout_s=10.0)
+
+        def closed_loop(samples, stop_at):
+            while time.monotonic() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    status, _ = post_predict()
+                except Exception:
+                    status = -1
+                with lock:
+                    samples.append((time.perf_counter() - t0, status))
+
+        def run_phase(seconds):
+            samples = []
+            stop_at = time.monotonic() + seconds
+            threads = [_threading.Thread(target=closed_loop,
+                                         args=(samples, stop_at))
+                       for _ in range(clients)]
+            for t in threads:
+                t.start()
+            return samples, threads
+
+        # Steady phase: the baseline tail through the SAME router.
+        samples, threads = run_phase(steady_s)
+        for t in threads:
+            t.join()
+        lat = sorted(s for s, status in samples if status == 200)
+        if not lat:
+            raise RuntimeError("steady phase served nothing")
+        entry["steady_requests"] = len(samples)
+        entry["steady_p50_ms"] = round(lat[len(lat) // 2] * 1e3, 2)
+        entry["steady_p99_ms"] = round(
+            _nearest_rank(lat, 0.99) * 1e3, 2)
+        _dump_partial(detail)
+
+        # Rollover phase: same load, with a live weights rollover fired
+        # 1s in (replacement spawn + warm-wait + swap + old drain all
+        # land inside the window).
+        rollover_result = {}
+
+        def trigger():
+            time.sleep(1.0)
+            try:
+                status, record = request_json(
+                    host, port, "POST", "/admin/rollover",
+                    body=json.dumps(
+                        {"weights_signature": "bench-v2"}).encode(),
+                    timeout_s=90.0)
+                rollover_result["status"] = status
+                rollover_result["record"] = record
+            except Exception as exc:
+                rollover_result["error"] = repr(exc)
+
+        samples, threads = run_phase(load_s)
+        trig = _threading.Thread(target=trigger)
+        trig.start()
+        for t in threads:
+            t.join()
+        trig.join(timeout=120.0)
+        record = rollover_result.get("record", {})
+        if not isinstance(record, dict):
+            record = {}
+        roll_detail = record.get("rollover", {})
+        entry["rollover_http_status"] = rollover_result.get("status")
+        # Gate on the ROLLOVER's own outcome (HTTP 200 + the rollover
+        # record's ok), not the fleet-wide contract ok — that one means
+        # "no circuit open" and could fail the section for an unrelated
+        # flapping worker while the rollover itself succeeded.
+        entry["rollover_ok"] = (rollover_result.get("status") == 200
+                                and bool(roll_detail.get("ok")))
+        if not entry["rollover_ok"]:
+            # A failed/never-fired rollover must NOT emit the gated
+            # contract keys: steady load over an undisturbed old fleet
+            # would trivially show 0 drops and a clean p99, and the
+            # zero-downtime gate would pass while the capability is
+            # broken. Missing keys fail check_perf_regression loudly
+            # (the plumbing-regression class).
+            raise RuntimeError(
+                "rollover did not complete: "
+                f"status={rollover_result.get('status')} "
+                f"error={rollover_result.get('error')}")
+        # The rollover must also land INSIDE the measured window (it
+        # fires at t=1s): a slow machine where spawn+warm-wait+drain
+        # outlives the sampling phase would gate pre-rollover traffic —
+        # trivially clean numbers that measured nothing.
+        roll_elapsed = roll_detail.get("elapsed_s")
+        if (not isinstance(roll_elapsed, (int, float))
+                or 1.0 + float(roll_elapsed) > load_s):
+            raise RuntimeError(
+                f"rollover (elapsed {roll_elapsed}s, fired at t=1s) did "
+                f"not complete inside the {load_s}s load window — the "
+                "gated keys would measure undisturbed traffic; raise "
+                "DI_BENCH_ROLLOVER_SECONDS on this machine")
+        lat = sorted(s for s, status in samples if status == 200)
+        dropped = sum(1 for _, status in samples if status != 200)
+        entry["requests_during_rollover"] = len(samples)
+        entry["dropped_requests"] = dropped
+        if lat:
+            entry["p99_during_rollover_ms"] = round(
+                _nearest_rank(lat, 0.99) * 1e3, 2)
+            entry["p99_ratio"] = round(
+                entry["p99_during_rollover_ms"]
+                / max(entry["steady_p99_ms"], 1e-9), 2)
+        entry["rollover_elapsed_s"] = roll_detail.get("elapsed_s")
+        entry["old_worker_drain_exit_codes"] = roll_detail.get(
+            "drain_exit_codes")
+        entry["failovers"] = record.get("failovers")
+        # Post-rollover proof: traffic is served by the NEW weights.
+        status, payload = post_predict()
+        if status == 200 and isinstance(payload, dict):
+            entry["post_rollover_signature"] = payload.get(
+                "weights_signature")
+        entry["note"] = (
+            "stub-worker fleet isolates the fleet layer's disruption "
+            "(routing swap, drain-race failover, warm-wait) from model "
+            "latency; dropped_requests counts every non-200 answer "
+            "during the rollover window — the zero-downtime bar is 0")
+    finally:
+        try:
+            router.drain()
+        except Exception:
+            pass
+        import shutil
+
+        shutil.rmtree(state_dir, ignore_errors=True)
+    _log(json.dumps({"rollover": {
+        k: entry.get(k) for k in (
+            "steady_p99_ms", "p99_during_rollover_ms", "p99_ratio",
+            "dropped_requests", "requests_during_rollover",
+            "rollover_elapsed_s", "failovers", "rollover_ok")}}))
     _dump_partial(detail)
 
 
@@ -1392,7 +1597,7 @@ def _section_result_key(name: str):
     if name == "eval_path":
         return None, "eval_path_b128"
     if name in ("tuned_ab", "stem_ab", "precision_ab", "screening",
-                "saturation", "attribution"):
+                "saturation", "rollover", "attribution"):
         return None, name
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
@@ -1425,6 +1630,8 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_screening_section(ctx, detail)
     elif name == "saturation":
         _run_saturation_section(ctx, detail)
+    elif name == "rollover":
+        _run_rollover_section(ctx, detail)
     elif name == "attribution":
         _run_attribution_section(ctx, detail)
     elif name.startswith("ab_p"):
@@ -1542,6 +1749,19 @@ def _build_headline(detail, scan_k) -> dict:
                       "served_per_sec", "reject_rate", "served",
                       "rejected", "deadline_expired", "oversubscription")
             if k in saturation}
+    rollover = detail.get("rollover", {})
+    if "p99_during_rollover_ms" in rollover:
+        # Zero-downtime rollover contract keys (ISSUE-13): the tail
+        # through a live weights rollover vs the same router's steady
+        # state, and the dropped-request count whose bar is zero. Gated
+        # in tools/check_perf_regression.py.
+        line["rollover"] = {
+            k: rollover[k]
+            for k in ("p99_during_rollover_ms", "steady_p99_ms",
+                      "p99_ratio", "dropped_requests",
+                      "requests_during_rollover", "rollover_elapsed_s",
+                      "failovers", "workers")
+            if k in rollover}
     screening = detail.get("screening", {})
     if "screen_pairs_per_sec" in screening:
         # The bulk-screening workload's own throughput row (ISSUE-6):
@@ -1570,7 +1790,7 @@ def _is_partial(detail) -> bool:
     candidates += [v for k, v in detail.items()
                    if k.startswith(("attention_ab", "eval_path", "tuned_ab",
                                     "stem_ab", "precision_ab", "screening",
-                                    "saturation", "attribution"))
+                                    "saturation", "rollover", "attribution"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates
                if isinstance(c, dict))

@@ -271,6 +271,66 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
                         "tracing: every traced request's queue-wait/"
                         "compile/device decomposition lands here under "
                         "its trace_id (obs/reqtrace.py)")
+    g.add_argument("--heartbeat_file", type=str, default=None,
+                   help="periodic liveness file (obs/heartbeat.py); the "
+                        "fleet supervisor sets this for every worker it "
+                        "spawns")
+    g.add_argument("--heartbeat_interval_s", type=float, default=5.0,
+                   help="heartbeat write cadence for --heartbeat_file")
+    g.add_argument("--parent_pid", type=int, default=0,
+                   help="drain and exit when this process is no longer "
+                        "our parent (the fleet supervisor sets it so a "
+                        "hard-killed supervisor never leaves orphaned "
+                        "workers serving forever; 0 disables)")
+    f = p.add_argument_group(
+        "fleet", "multi-worker serving (serving/fleet.py + router.py): "
+        "a supervisor keeps N engine-worker processes alive behind an "
+        "HTTP router with health-checked failover and zero-downtime "
+        "warm rollover (POST /admin/rollover or SIGHUP)")
+    f.add_argument("--workers", type=int, default=0,
+                   help="> 0: run the fleet (supervisor + router on "
+                        "--port, N engine workers on free ports); 0 = "
+                        "the classic single-engine server")
+    f.add_argument("--fleet_stub_workers", action="store_true",
+                   help="rehearsal fleet: workers are serving/"
+                        "worker_stub.py null engines (no model, ~1s "
+                        "startup) — fleet chaos game-days and the bench "
+                        "rollover section")
+    f.add_argument("--fleet_dir", type=str, default=None,
+                   help="supervisor state dir (heartbeats, worker logs, "
+                        "fleet_state.json); default: a fresh temp dir")
+    f.add_argument("--probe_interval_s", type=float, default=1.0,
+                   help="supervisor monitor cadence: process poll + "
+                        "/healthz probe + heartbeat staleness per tick")
+    f.add_argument("--heartbeat_max_age_s", type=float, default=15.0,
+                   help="a worker heartbeat older than this is stale "
+                        "(unroutable); 3x older with a live process is "
+                        "wedged and gets SIGKILLed into the restart path")
+    f.add_argument("--restart_backoff_s", type=float, default=0.5,
+                   help="base of the exponential restart backoff for "
+                        "crashed workers (jittered, capped at 30s)")
+    f.add_argument("--circuit_max_restarts", type=int, default=5,
+                   help="restarts inside --circuit_window_s after which "
+                        "a flapping worker's circuit opens (no more "
+                        "restarts; the rest of the fleet keeps serving)")
+    f.add_argument("--circuit_window_s", type=float, default=60.0,
+                   help="sliding window for --circuit_max_restarts")
+    f.add_argument("--fleet_warm_timeout_s", type=float, default=300.0,
+                   help="rollover bound: how long a replacement worker "
+                        "may take to report warm before the rollover "
+                        "aborts (old fleet keeps serving)")
+    f.add_argument("--rollover", action="store_true",
+                   help="client mode: POST /admin/rollover to the fleet "
+                        "router at --host/--port and exit (final stdout "
+                        "line is the fleet/v1 contract)")
+    f.add_argument("--rollover_ckpt", type=str, default=None,
+                   help="with --rollover: checkpoint dir the replacement "
+                        "workers restore (default: same as the running "
+                        "fleet)")
+    f.add_argument("--rollover_signature", type=str, default=None,
+                   help="with --rollover: required weights_signature the "
+                        "replacements must report before traffic "
+                        "switches (verifies the right weights landed)")
 
 
 def add_screening_args(p: argparse.ArgumentParser) -> None:

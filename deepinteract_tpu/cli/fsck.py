@@ -38,6 +38,10 @@ from deepinteract_tpu.robustness import artifacts
 # legacy coverage edge).
 KNOWN_UNVERIFIED_BASENAMES = ("trainer_state.json", "tuning_store.json")
 
+# A heartbeat this old is reported stale (obs/heartbeat.read_heartbeat
+# does the math — shared with the fleet supervisor's liveness check).
+HEARTBEAT_MAX_AGE_S = 300.0
+
 
 def _known_json_artifact(name: str) -> bool:
     # Heartbeats are per-process files: obs/heartbeat_p<N>.json
@@ -106,6 +110,25 @@ def _check_file(path: str, report: Dict, require_sidecar: bool = False) -> None:
     report["verified"] += 1
 
 
+def _check_heartbeat(path: str, report: Dict) -> None:
+    """Liveness classification through the ONE shared staleness check
+    (obs/heartbeat.read_heartbeat — the same helper the fleet supervisor
+    probes with), so fsck and supervision cannot disagree about "how old
+    is too old". Staleness is informational (the writer may simply have
+    finished), never a corruption — integrity is checked separately
+    above."""
+    from deepinteract_tpu.obs.heartbeat import read_heartbeat
+
+    status = read_heartbeat(path, HEARTBEAT_MAX_AGE_S)
+    report.setdefault("heartbeats", {})[path] = {
+        "status": status.status,
+        "age_s": (round(status.age_s, 1)
+                  if status.age_s is not None else None),
+    }
+    if status.status == "stale":
+        report["stale_heartbeats"] = report.get("stale_heartbeats", 0) + 1
+
+
 def _mark_corrupt(path: str, reason: str, kind: str, report: Dict) -> None:
     report["corrupt_paths"].append({"path": path, "kind": kind,
                                     "reason": reason})
@@ -154,6 +177,8 @@ def scan(root: str, do_quarantine: bool, do_sweep: bool) -> Dict:
             spill = name.startswith("emb_") and name.endswith(".npz")
             if has_sidecar or spill or _known_json_artifact(name):
                 _check_file(path, report, require_sidecar=spill)
+            if name.startswith("heartbeat") and name.endswith(".json"):
+                _check_heartbeat(path, report)
     if do_sweep or do_quarantine:
         for path in report["tmp_paths"]:
             try:
@@ -191,6 +216,9 @@ def main(argv=None) -> int:
 
     report = scan(root, args.quarantine, args.sweep_tmp)
 
+    for path, hb in sorted(report.get("heartbeats", {}).items()):
+        if hb["status"] == "stale":
+            print(f"stale heartbeat ({hb['age_s']}s old): {path}")
     for path in report["unverified_paths"]:
         print(f"unverified (no integrity sidecar): {path}")
     for path in report["orphan_sidecars"]:
@@ -217,6 +245,7 @@ def main(argv=None) -> int:
         "quarantined": report["quarantined"],
         "recovered": recovered,
         "orphan_sidecars": len(report["orphan_sidecars"]),
+        "stale_heartbeats": report.get("stale_heartbeats", 0),
         "tmp_files": len(report["tmp_paths"]),
         "tmp_swept": report["tmp_swept"],
         "corrupt_paths": [e["path"] for e in report["corrupt_paths"][:20]],

@@ -1,9 +1,11 @@
 """Serve CLI — resident HTTP inference engine (deepinteract_tpu.serving).
 
-Starts a persistent process that restores the checkpoint once, compiles
-one executable per padded shape bucket (optionally ahead of time via
-``--warmup_buckets``), micro-batches concurrent requests per bucket, and
-answers a JSON API::
+Three modes share one flag surface:
+
+**Single engine** (default). A persistent process that restores the
+checkpoint once, compiles one executable per padded shape bucket
+(optionally ahead of time via ``--warmup_buckets``), micro-batches
+concurrent requests per bucket, and answers a JSON API::
 
     python -m deepinteract_tpu.cli.serve --ckpt_name ckpts/run1 \
         --port 8008 --warmup_buckets 128x128x1,128x128x8
@@ -14,12 +16,30 @@ answers a JSON API::
 
 SIGTERM drains in-flight requests and exits 0 (the PR-1 preemption
 discipline), so rolling restarts never drop accepted work.
+
+**Fleet** (``--workers N``). A supervisor/router pair
+(``serving/fleet.py`` + ``serving/router.py``) in front of N
+single-engine worker processes (each a child running this CLI with
+``--workers 0`` on a free port): crashed workers restart with
+exponential backoff (flappers trip a circuit breaker), dead-worker
+requests fail over to a sibling, and ``POST /admin/rollover`` / SIGHUP
+performs a zero-downtime warm weights rollover. The final stdout line on
+exit is the machine-readable ``fleet/v1`` contract. ``--fleet_stub_workers``
+swaps the engine workers for ``serving/worker_stub.py`` null engines
+(fleet game-days / bench rehearsal).
+
+**Rollover client** (``--rollover``). Sends ``POST /admin/rollover`` to
+the router at ``--host``/``--port`` (optionally with ``--rollover_ckpt``
+/ ``--rollover_signature``) and exits 0 iff the rollover completed; the
+final stdout line is the router's ``fleet/v1`` response.
 """
 
 from __future__ import annotations
 
+import json
 import sys
-from typing import Tuple
+from typing import Dict, List, Optional, Tuple
+
 
 from deepinteract_tpu.cli.args import add_serving_args, build_parser, configs_from_args
 
@@ -44,10 +64,156 @@ def parse_warmup_spec(spec: str) -> Tuple[Tuple[int, int, int], ...]:
     return tuple(out)
 
 
-def main(argv=None) -> int:
+def warm_bucket_prefixes(spec: str, max_batch: int = 8,
+                         pad_to_max_bucket: bool = False,
+                         diagonal_buckets: bool = False) -> Tuple[str, ...]:
+    """Warmup specs -> the compile-inventory label prefixes a rollover
+    replacement must report warm.
+
+    Mirrors the engine's own spec normalization (``normalize_warmup``:
+    loader bucket policy for the shapes, power-of-two slot rounding
+    capped at ``max_batch`` for the batch) so ``(128, 128, 8)``
+    requires ``"128x128/b8/"`` — the BATCH dimension is part of
+    readiness, or a replacement warm at b1 only would pass the check
+    and the first b8 flush would pay the cold-compile cliff the
+    rollover contract promises away. Only the per-graph signature tail
+    (``k20g2...``) is left open. Over-top-bucket specs additionally
+    tile-lift inside the engine and may not match — a loud rollover
+    abort, never a silent cold switch."""
+    from deepinteract_tpu.data.loader import make_bucket_fn
+    from deepinteract_tpu.serving.fleet import batch_slots
+
+    bucket_fn = make_bucket_fn(pad_to_max_bucket, diagonal_buckets)
+    out = []
+    for b1, b2, bs in parse_warmup_spec(spec):
+        nb1, nb2 = bucket_fn(b1, b2)
+        out.append(f"{nb1}x{nb2}/b{batch_slots(bs, max_batch)}/")
+    return tuple(out)
+
+
+def engine_worker_cmd_fn(argv: List[str]):
+    """Worker command factory for REAL engine workers: this CLI again,
+    with the fleet flags neutralized by appending single-engine
+    overrides (argparse last-occurrence-wins) plus the worker's port and
+    heartbeat file. Rollover ``overrides`` append last of all, so
+    ``{"ckpt_name": new}`` repoints the replacement's checkpoint."""
+    base = list(argv)
+
+    def cmd_fn(worker_id: str, port: int, heartbeat_path: str,
+               overrides: Dict) -> List[str]:
+        import os
+
+        cmd = [sys.executable, "-m", "deepinteract_tpu.cli.serve"]
+        cmd += base
+        cmd += ["--workers", "0", "--host", "127.0.0.1",
+                "--port", str(port), "--heartbeat_file", heartbeat_path,
+                "--parent_pid", str(os.getpid())]
+        for key in ("ckpt_name", "ckpt_dir", "compute_dtype",
+                    "warmup_buckets"):
+            if overrides.get(key):
+                cmd += [f"--{key}", str(overrides[key])]
+        return cmd
+
+    return cmd_fn
+
+
+def _fleet_main(args, argv: List[str], guard=None) -> int:
+    """Supervisor + router (no engine in THIS process — workers own
+    their engines, so the parent stays a lightweight control plane)."""
+    import tempfile
+
+    from deepinteract_tpu.serving.fleet import (
+        FleetConfig,
+        WorkerSupervisor,
+        stub_worker_cmd,
+    )
+    from deepinteract_tpu.serving.router import FleetRouter, RouterConfig
+
+    state_dir = args.fleet_dir or tempfile.mkdtemp(prefix="di_fleet_")
+    cmd_fn = (stub_worker_cmd if args.fleet_stub_workers
+              else engine_worker_cmd_fn(argv))
+    required_warm = warm_bucket_prefixes(
+        args.warmup_buckets, max_batch=args.max_batch,
+        pad_to_max_bucket=args.pad_to_max_bucket,
+        diagonal_buckets=args.diagonal_buckets)
+    base_overrides = {}
+    if args.fleet_stub_workers and required_warm:
+        # Stubs must REPORT the operator's warmup buckets warm, or the
+        # router's rollover readiness check (prefix match against
+        # --warmup_buckets) would wait out the warm timeout and abort
+        # every rehearsal rollover on a non-default spec.
+        base_overrides["warm_buckets"] = ",".join(required_warm)
+    supervisor = WorkerSupervisor(
+        cmd_fn,
+        overrides=base_overrides,
+        cfg=FleetConfig(
+            num_workers=args.workers,
+            probe_interval_s=args.probe_interval_s,
+            heartbeat_max_age_s=args.heartbeat_max_age_s,
+            restart_backoff_s=args.restart_backoff_s,
+            circuit_max_restarts=args.circuit_max_restarts,
+            circuit_window_s=args.circuit_window_s,
+            state_dir=state_dir,
+        ))
+    router = FleetRouter(
+        supervisor, host=args.host, port=args.port,
+        cfg=RouterConfig(
+            proxy_timeout_s=args.request_timeout_s,
+            default_deadline_ms=args.default_deadline_ms,
+            required_warm_buckets=required_warm,
+            warm_timeout_s=args.fleet_warm_timeout_s,
+        ))
+    router.start()
+    host, port = router.address
+    print(f"fleet router on http://{host}:{port} "
+          f"({args.workers} worker(s)"
+          f"{', stub' if args.fleet_stub_workers else ''}; "
+          f"state in {state_dir})", flush=True)
+    try:
+        return router.run(guard=guard)
+    finally:
+        print(json.dumps(router.final_contract()), flush=True)
+
+
+def _rollover_main(args) -> int:
+    """One-shot rollover client against a running fleet router."""
+    from deepinteract_tpu.serving.fleet import request_json
+
+    body: Dict = {}
+    if args.rollover_ckpt:
+        body["ckpt_name"] = args.rollover_ckpt
+    if args.rollover_signature:
+        body["weights_signature"] = args.rollover_signature
+    # The admin call spans replacement warm-up AND the old fleet's
+    # PARALLEL drain (bounded by the router's drain_timeout_s, 60s —
+    # not --request_timeout_s, which only bounds individual predicts);
+    # budget both phases plus slack so a slow-but-successful rollover
+    # never reads as a client timeout.
+    status, record = request_json(
+        args.host, args.port, "POST", "/admin/rollover",
+        body=json.dumps(body).encode(),
+        timeout_s=args.fleet_warm_timeout_s + 60.0
+        + args.request_timeout_s + 30.0)
+    print(f"rollover answered {status}", flush=True)
+    print(json.dumps(record), flush=True)
+    # Exit code follows the ROLLOVER's own outcome, not the fleet-wide
+    # contract "ok" (which means "no circuit open" and could be false
+    # for an unrelated flapping worker while this rollover succeeded).
+    roll = record.get("rollover", {}) if isinstance(record, dict) else {}
+    return 0 if status == 200 and roll.get("ok") else 1
+
+
+def main(argv=None, guard=None) -> int:
     parser = build_parser(__doc__)
     add_serving_args(parser)
     args = parser.parse_args(argv)
+
+    if args.rollover:
+        return _rollover_main(args)
+    if args.workers > 0:
+        return _fleet_main(
+            args, list(sys.argv[1:] if argv is None else argv),
+            guard=guard)
 
     from deepinteract_tpu.obs import spans as obs_spans
     from deepinteract_tpu.serving import EngineConfig, InferenceEngine, ServingServer
@@ -77,6 +243,20 @@ def main(argv=None) -> int:
             print(f"autotune: tuning store {tuning_store} not found; "
                   "serving with default configs")
             tuning_store = None
+
+    heartbeat: Optional[object] = None
+    if args.heartbeat_file:
+        # Started BEFORE engine construction: checkpoint restore + AOT
+        # warmup is the most hang-prone window a worker has, and a
+        # supervisor watching a missing-until-warm heartbeat would be
+        # blind to exactly that phase. The beat thread is independent
+        # of the (busy) main thread, so liveness coverage begins now.
+        from deepinteract_tpu.obs.heartbeat import Heartbeat
+
+        heartbeat = Heartbeat(args.heartbeat_file,
+                              interval_s=args.heartbeat_interval_s)
+        heartbeat.progress(role="engine-worker-starting")
+        heartbeat.start()
 
     model_cfg, _, _ = configs_from_args(args)
     from deepinteract_tpu.cli.args import pinned_knobs
@@ -126,7 +306,28 @@ def main(argv=None) -> int:
           flush=True)
     if stats["tuning"]["adopted"]:
         print(f"autotune: adopted ({stats['tuning']['adopted']})", flush=True)
-    return server.run()
+    if heartbeat is not None:
+        # Serving now: the beat carries the served weights' identity so
+        # a stale-vs-wrong-weights worker is diagnosable from the file
+        # alone.
+        heartbeat.progress(role="engine-worker", port=port,
+                           weights_signature=engine.weights_signature())
+    if args.parent_pid > 0:
+        # A hard-killed supervisor must not leave this worker serving
+        # as an orphan: route parent death into the normal SIGTERM
+        # drain (the guard path run() installs).
+        import os as _os
+        import signal as _signal
+
+        from deepinteract_tpu.serving.fleet import watch_parent
+
+        watch_parent(args.parent_pid,
+                     lambda: _os.kill(_os.getpid(), _signal.SIGTERM))
+    try:
+        return server.run(guard=guard)
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
 
 
 if __name__ == "__main__":

@@ -20,6 +20,7 @@ gone. Writes are atomic (tmp + rename): a reader never sees a torn file.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import socket
@@ -123,3 +124,66 @@ def read(path: str) -> Dict[str, Any]:
     """Parse a heartbeat file (operator tooling + tests)."""
     with open(path, encoding="utf-8") as f:
         return json.load(f)
+
+
+@dataclasses.dataclass(frozen=True)
+class HeartbeatStatus:
+    """One liveness verdict, shared by every heartbeat consumer.
+
+    ``status`` is ``"fresh"`` (written within ``max_age_s``), ``"stale"``
+    (file exists but the writer stopped beating — the process or its host
+    is gone, or the beat thread is wedged), or ``"missing"`` (no file:
+    the process never started, or it was configured without a
+    heartbeat). ``age_s`` is seconds since the last write (None when
+    missing); ``payload`` is the parsed beat (None when missing or
+    unreadable)."""
+
+    status: str
+    age_s: Optional[float]
+    payload: Optional[Dict[str, Any]]
+
+    @property
+    def fresh(self) -> bool:
+        return self.status == "fresh"
+
+
+def read_heartbeat(path: str, max_age_s: float,
+                   now: Optional[float] = None) -> HeartbeatStatus:
+    """Classify a heartbeat file as fresh / stale / missing.
+
+    The ONE liveness check the fleet supervisor (serving/fleet.py) and
+    ``cli/fsck.py`` share, so "how old is too old" math lives in exactly
+    one place. Age is judged from the payload's own ``written_ts`` when
+    present (the writer's clock — mtime can lie on copied/restored
+    trees), falling back to the file mtime for torn-or-foreign files. A
+    file that exists but does not parse is STALE, not missing: writes
+    are atomic, so unreadable bytes mean a writer that stopped being a
+    heartbeat, which is exactly the dead-process signal."""
+    now = time.time() if now is None else now
+    try:
+        st_mtime = os.stat(path).st_mtime
+    except OSError:
+        return HeartbeatStatus("missing", None, None)
+    payload: Optional[Dict[str, Any]] = None
+    written = st_mtime
+    unreadable = False
+    try:
+        loaded = read(path)
+        if isinstance(loaded, dict):
+            payload = loaded
+            ts = loaded.get("written_ts")
+            if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+                written = float(ts)
+        else:
+            unreadable = True  # JSON, but not a beat object
+    except (OSError, ValueError):
+        unreadable = True
+    age = max(0.0, now - written)
+    if unreadable:
+        # Our own writes are atomic, so unreadable bytes mean whatever
+        # writes this path stopped being a heartbeat — the dead-process
+        # signal, regardless of how recently the foreign writer touched
+        # the file.
+        return HeartbeatStatus("stale", age, None)
+    status = "fresh" if age <= max(0.0, float(max_age_s)) else "stale"
+    return HeartbeatStatus(status, age, payload)

@@ -70,6 +70,15 @@ class _Family:
             self._series.clear()
             self._init_default_series()
 
+    def remove(self, **labels) -> None:
+        """Drop ONE labeled series. For label values with a bounded
+        lifetime (a retired fleet worker's id): a long-lived process
+        must be able to shed dead series or its scrape grows without
+        bound. No-op when the series does not exist."""
+        key = self._key(labels)
+        with self._lock:
+            self._series.pop(key, None)
+
     def _init_default_series(self) -> None:
         """Unlabeled families expose a zero-valued series from creation
         (the prometheus_client convention): a scrape shows the metric

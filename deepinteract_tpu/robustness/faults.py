@@ -42,6 +42,13 @@ Registered sites:
 * ``checkpoint.restore`` — marks a checkpoint step corrupt at restore
   verification, driving the last-good fallback walk
   (training/checkpoint.py)
+* ``fleet.spawn``         — raises OSError before a worker process is
+  spawned (serving/fleet.py; exercises the restart backoff path)
+* ``fleet.probe``         — raises ConnectionError at a worker health
+  probe (a healthy worker looks unreachable to the supervisor)
+* ``fleet.kill``          — raises OSError when the supervisor delivers
+  a signal to a worker (a drain's SIGTERM fails; the SIGKILL fallback
+  must still retire the worker)
 
 When no plan is configured every probe is a dict lookup on an empty map —
 effectively free on hot paths.

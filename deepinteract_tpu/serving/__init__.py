@@ -1,41 +1,65 @@
-"""Persistent serving layer: resident engine, micro-batching, HTTP API.
+"""Persistent serving layer: resident engine, micro-batching, HTTP API,
+and the multi-worker fleet (supervisor + router).
 
 The production counterpart of the one-shot ``cli/predict.py`` path:
 compile once per shape bucket, batch concurrent requests into shared
 device dispatches, cache repeated complexes, and drain cleanly on
-preemption. See ``engine.py`` for the amortization model and
-``server.py`` for the wire protocol.
+preemption. See ``engine.py`` for the amortization model, ``server.py``
+for the wire protocol, and ``fleet.py``/``router.py`` for the
+multi-worker supervision/rollover layer.
+
+Exports resolve LAZILY (PEP 562): importing the package does not pull
+``engine`` (and with it jax) until an engine-side name is touched. The
+fleet control plane and the ``worker_stub`` rehearsal worker live in
+this package but are deliberately jax-free — ``python -m
+deepinteract_tpu.serving.worker_stub`` starts in a fraction of a second
+BECAUSE this module stays import-light, and every supervisor restart in
+a chaos run pays that startup cost again.
 """
 
-from deepinteract_tpu.serving.admission import (
-    AdmissionController,
-    BatchExecutionError,
-    Deadline,
-    DeadlineExceeded,
-    LoadShedder,
-    Overloaded,
-    ShedderConfig,
-    ShuttingDown,
-)
-from deepinteract_tpu.serving.cache import ResultCache, content_hash
-from deepinteract_tpu.serving.engine import EngineConfig, InferenceEngine
-from deepinteract_tpu.serving.scheduler import MicroBatchScheduler, SchedulerClosed
-from deepinteract_tpu.serving.server import ServingServer
+# name -> submodule it lazily resolves from.
+_EXPORTS = {
+    "AdmissionController": "admission",
+    "BatchExecutionError": "admission",
+    "Deadline": "admission",
+    "DeadlineExceeded": "admission",
+    "LoadShedder": "admission",
+    "Overloaded": "admission",
+    "ShedderConfig": "admission",
+    "ShuttingDown": "admission",
+    "ResultCache": "cache",
+    "content_hash": "cache",
+    "EngineConfig": "engine",
+    "InferenceEngine": "engine",
+    "FleetConfig": "fleet",
+    "WorkerSupervisor": "fleet",
+    "stub_worker_cmd": "fleet",
+    "watch_parent": "fleet",
+    "FleetRouter": "router",
+    "RolloverBusy": "router",
+    "RolloverFailed": "router",
+    "RouterConfig": "router",
+    "MicroBatchScheduler": "scheduler",
+    "SchedulerClosed": "scheduler",
+    "ServingServer": "server",
+}
 
-__all__ = [
-    "AdmissionController",
-    "BatchExecutionError",
-    "Deadline",
-    "DeadlineExceeded",
-    "EngineConfig",
-    "InferenceEngine",
-    "LoadShedder",
-    "MicroBatchScheduler",
-    "Overloaded",
-    "ResultCache",
-    "SchedulerClosed",
-    "ShedderConfig",
-    "ShuttingDown",
-    "ServingServer",
-    "content_hash",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        modname = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    module = importlib.import_module(f"{__name__}.{modname}")
+    value = getattr(module, name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -58,6 +58,7 @@ from deepinteract_tpu.serving.admission import (
     expired_counter,
 )
 from deepinteract_tpu.serving.cache import ResultCache, content_hash
+from deepinteract_tpu.serving.fleet import batch_slots as fleet_batch_slots
 from deepinteract_tpu.serving.scheduler import MicroBatchScheduler
 
 logger = logging.getLogger(__name__)
@@ -172,6 +173,14 @@ class InferenceEngine:
         self._executables: Dict[Tuple[int, int, int, int, int], Any] = {}
         self._compile_seconds: Dict[str, float] = {}
         self._exec_lock = threading.Lock()
+        # Compile-inventory labels mirrored under their OWN tiny lock:
+        # /healthz reads them every supervisor probe tick and must
+        # never block behind _exec_lock, which a cold compile holds for
+        # its full lower+compile duration — a compiling-but-alive
+        # worker that fails health probes would drop out of routing
+        # fleet-wide. Nesting order is _exec_lock -> _labels_lock only.
+        self._warm_labels: Tuple[str, ...] = ()
+        self._labels_lock = threading.Lock()
         # Incremented by a Python side effect inside the traced function,
         # so it counts TRACES (not calls): the warm-path zero-retrace
         # guarantee is asserted on this counter, not inferred.
@@ -364,9 +373,10 @@ class InferenceEngine:
     def _batch_slots(self, n_requests: int) -> int:
         """Coalesced groups pad to the next power of two (capped at
         max_batch) so the per-bucket executable inventory stays
-        O(log max_batch) instead of one compile per observed group size."""
-        slots = 1 << (max(1, n_requests) - 1).bit_length()
-        return min(slots, self.cfg.max_batch)
+        O(log max_batch) instead of one compile per observed group
+        size. Delegates to the shared policy the fleet's rollover
+        readiness check also uses (serving/fleet.batch_slots)."""
+        return fleet_batch_slots(n_requests, self.cfg.max_batch)
 
     # -- compile cache -----------------------------------------------------
 
@@ -443,6 +453,14 @@ class InferenceEngine:
         key (an embedding is a function of chain features AND weights)."""
         return self.restored_from or f"init-seed{self._seed}"
 
+    def warm_bucket_labels(self) -> list:
+        """Sorted compile-inventory labels (the ``compiled_buckets``
+        keys of :meth:`stats`) from the NON-BLOCKING mirror —
+        ``/healthz`` is probed every supervisor tick and must answer
+        while a cold compile holds ``_exec_lock`` for minutes."""
+        with self._labels_lock:
+            return list(self._warm_labels)
+
     def _compiled(self, key: Tuple, label: str, jit_fn, args):
         """Warm path: dict hit, zero traces. Cold path: one explicit
         lower+compile, recorded in the per-bucket inventory. Shared by the
@@ -461,6 +479,8 @@ class InferenceEngine:
             self._executables[key] = compiled
             elapsed = time.perf_counter() - t0
             self._compile_seconds[label] = elapsed
+            with self._labels_lock:
+                self._warm_labels = tuple(sorted(self._compile_seconds))
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(elapsed)
             return compiled

@@ -1,0 +1,938 @@
+"""Worker supervision: spawn, watch, restart, and retire engine workers.
+
+One serving process (PR 2/11/12) is overload-safe and durable, but it is
+still ONE process: a crash, a preemption, or a weights update is
+client-visible downtime. The fleet layer splits serving into a
+supervisor/router pair (this module + ``serving/router.py``) in front of
+N single-engine worker processes (``cli/serve.py`` with ``--workers 0``,
+or the ``serving/worker_stub.py`` rehearsal double):
+
+* **spawn** — each worker is a child process with its own port,
+  heartbeat file, and log, built by an injectable ``cmd_fn`` (the CLI
+  provides the real engine-worker command line; tests and the bench
+  ``rollover`` section provide :func:`stub_worker_cmd`);
+* **watch** — a monitor thread polls every worker: process liveness
+  (``Popen.poll``), heartbeat freshness
+  (:func:`deepinteract_tpu.obs.heartbeat.read_heartbeat` — the SAME
+  staleness check ``cli/fsck.py`` uses), and a ``GET /healthz`` probe
+  whose payload (``weights_signature``, ``warm_buckets``) the router
+  reads for routing and rollover-readiness decisions. A live process
+  with a wedged beat (stale past ``wedge_kill_factor`` times the max
+  age) is SIGKILLed so the normal crash-restart path recovers it;
+* **restart** — a crashed worker is respawned with PR-1 exponential
+  backoff (``robustness/retry.compute_delay``: jittered, capped), and a
+  flapping worker — more than ``circuit_max_restarts`` restarts inside
+  ``circuit_window_s`` — opens a circuit breaker: the supervisor stops
+  feeding it restarts (a poisoned checkpoint or bad flag would otherwise
+  crash-loop forever), keeps the rest of the fleet serving, and reports
+  the open circuit on ``/stats`` + ``di_fleet_circuit_open``;
+* **retire** — rollover and shutdown drain workers through their own
+  SIGTERM path (PR-1/PR-11 discipline: finish in-flight, exit 0) and
+  mark them retired so an expected exit is never misread as a crash.
+
+Chaos sites (``robustness/faults.py``): ``fleet.spawn`` fails a worker
+spawn (exercises the backoff path), ``fleet.probe`` poisons a health
+probe (worker looks unreachable), ``fleet.kill`` fails the SIGTERM of a
+drain (the SIGKILL fallback must still retire the worker).
+
+Supervisor state (worker states, restart counts, exit codes) is
+persisted to ``<state_dir>/fleet_state.json`` through
+``robustness/artifacts.atomic_write`` after every transition, so an
+operator (or fsck) reading mid-crash never sees torn JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from http.server import ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.obs.heartbeat import HeartbeatStatus, read_heartbeat
+from deepinteract_tpu.robustness import artifacts, faults
+from deepinteract_tpu.robustness.retry import compute_delay
+
+logger = logging.getLogger(__name__)
+
+_RESTARTS = obs_metrics.counter(
+    "di_fleet_worker_restarts_total",
+    "Crashed workers respawned by the supervisor", labelnames=("worker",))
+_SPAWN_FAILURES = obs_metrics.counter(
+    "di_fleet_spawn_failures_total",
+    "Worker spawn attempts that failed (retried with backoff)",
+    labelnames=("worker",))
+_PROBE_FAILURES = obs_metrics.counter(
+    "di_fleet_probe_failures_total",
+    "Health probes that errored or timed out", labelnames=("worker",))
+_WEDGE_KILLS = obs_metrics.counter(
+    "di_fleet_wedge_kills_total",
+    "Live-but-wedged workers (stale heartbeat) SIGKILLed for restart",
+    labelnames=("worker",))
+_UP = obs_metrics.gauge(
+    "di_fleet_worker_up", "1 while the worker process is alive and probed "
+    "healthy", labelnames=("worker",))
+_CIRCUIT = obs_metrics.gauge(
+    "di_fleet_circuit_open",
+    "1 while the worker's restart circuit breaker is open",
+    labelnames=("worker",))
+_WORKERS_TOTAL = obs_metrics.gauge(
+    "di_fleet_workers_total", "Workers under supervision (not retired)")
+_WORKERS_HEALTHY = obs_metrics.gauge(
+    "di_fleet_workers_healthy", "Workers currently probed healthy")
+
+# Retired worker records kept around for /stats & fleet_state.json
+# visibility; older ones are GC'd so a long-lived fleet's daily
+# rollovers cannot grow supervisor memory, gauge cardinality, and the
+# state file without bound.
+RETIRED_RETENTION = 8
+
+# Worker command factory: (worker_id, port, heartbeat_path, overrides) ->
+# argv. ``overrides`` carries rollover-time replacements (e.g. a new
+# ``ckpt_name`` / target ``weights_signature``) interpreted by the
+# factory, so the supervisor never needs to know a worker's flag surface.
+CmdFn = Callable[[str, int, str, Dict[str, Any]], List[str]]
+
+
+def fan_out(tasks: Dict[str, Callable[[], Any]],
+            join_timeout_s: Optional[float] = None,
+            name: str = "fanout") -> Dict[str, Any]:
+    """Run named thunks concurrently (one thread each) and return the
+    results of those that finished — the ONE fan-out the parallel
+    drains, health probes, and the router's aggregation fetches share,
+    so their join/timeout semantics cannot drift.
+
+    ``join_timeout_s`` is a COLLECTIVE deadline (None = wait forever):
+    each join consumes the remaining budget, so N hung thunks cost one
+    timeout total, not N. Threads are daemon — a thunk wedged past the
+    deadline (hung NFS stat, a worker dribbling bytes forever) is
+    abandoned, its key absent from the result, and it can never block
+    interpreter exit. Callers decide what a missing key means. The
+    RETURNED dict is a post-join snapshot the worker threads never
+    touch — a late completion writes into its own pre-created slot and
+    can never resize a dict the caller is iterating."""
+    _PENDING = object()
+    slots: Dict[str, Any] = {key: _PENDING for key in tasks}
+    threads = [threading.Thread(
+        target=lambda k=key, thunk=fn: slots.__setitem__(k, thunk()),
+        name=f"{name}-{key}", daemon=True) for key, fn in tasks.items()]
+    for t in threads:
+        t.start()
+    deadline = (None if join_timeout_s is None
+                else time.monotonic() + join_timeout_s)
+    for t in threads:
+        t.join(timeout=None if deadline is None
+               else max(0.0, deadline - time.monotonic()))
+    return {key: value for key, value in slots.items()
+            if value is not _PENDING}
+
+
+def watch_parent(parent_pid: int, on_orphan: Callable[[], None],
+                 interval_s: float = 1.0) -> Optional[threading.Thread]:
+    """Daemon thread firing ``on_orphan`` ONCE when ``parent_pid`` stops
+    being this process's parent.
+
+    A SIGKILLed (or otherwise hard-killed) supervisor cannot drain its
+    workers — without this, they would keep serving as orphans forever,
+    invisible to any router. Workers run it against the supervisor pid
+    (``--parent_pid``, set by the worker command factories) and route
+    the orphan event into their own drain path, so supervisor death
+    degrades to the same clean exit a rollover drain produces. No-op
+    (returns None) when ``parent_pid <= 0``."""
+    if parent_pid <= 0:
+        return None
+
+    def _loop():
+        while True:
+            if os.getppid() != parent_pid:
+                logger.error(
+                    "parent %d is gone (ppid now %d): draining — an "
+                    "orphaned worker must not serve forever",
+                    parent_pid, os.getppid())
+                try:
+                    on_orphan()
+                except Exception:  # noqa: BLE001 - watcher must not crash
+                    logger.exception("orphan hook failed")
+                return
+            time.sleep(interval_s)
+
+    thread = threading.Thread(target=_loop, name="parent-watch",
+                              daemon=True)
+    thread.start()
+    return thread
+
+
+def endpoint_label(path: str, routes: Sequence[str]) -> str:
+    """Metric label for a request path: the matched route, else
+    ``"other"`` — unknown client paths (scanners, typos) must not mint
+    unbounded label series. Shared by the router and the worker stub
+    (the real server has its own pre-fleet copy)."""
+    route = path.partition("?")[0]
+    return route if route in routes else "other"
+
+
+class QuietHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose handler-thread errors go to debug
+    logging instead of stderr tracebacks: routine client disconnects
+    (a router abandoning a SIGKILLed sibling's keep-alive socket, a
+    drain tearing idle connections) are not incidents. Shared by the
+    router and the worker stub; real failures are answered as 4xx/5xx
+    JSON by the handlers themselves."""
+
+    def handle_error(self, request, client_address):  # noqa: N802
+        logger.debug("connection error from %s", client_address,
+                     exc_info=True)
+
+
+def batch_slots(n_requests: int, max_batch: int) -> int:
+    """Coalesced-group padding policy: next power of two, capped at
+    ``max_batch``. ONE implementation shared by the engine's executable
+    inventory (``InferenceEngine._batch_slots``) and the rollover
+    readiness prefixes (``cli/serve.warm_bucket_prefixes``) — if these
+    drifted, replacements would compile labels the router's warm check
+    no longer matches and every rollover would abort on timeout."""
+    slots = 1 << (max(1, int(n_requests)) - 1).bit_length()
+    return min(slots, max(1, int(max_batch)))
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (bind-0 probe). Racy in principle;
+    in practice the child binds it within milliseconds, and a lost race
+    surfaces as a spawn-then-crash the restart path already handles."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return int(s.getsockname()[1])
+
+
+def request_json(host: str, port: int, method: str, path: str,
+                 body: Optional[bytes] = None, timeout_s: float = 2.0):
+    """One HTTP round trip returning ``(status, parsed_json_or_text)``.
+    The ONE http.client block the supervisor probe, the router's
+    aggregation fetches, and the rollover client share — transport
+    errors propagate to the caller for classification."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        headers = {"Content-Type": "application/json"} if body else {}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        ctype = resp.getheader("Content-Type", "")
+        if ctype.startswith("application/json"):
+            return resp.status, json.loads(text)
+        return resp.status, text
+    finally:
+        conn.close()
+
+
+def probe_healthz(host: str, port: int, timeout_s: float = 2.0) -> Dict:
+    """One ``GET /healthz`` against a worker; raises on any transport or
+    parse failure (the caller counts and classifies). ``fleet.probe`` is
+    the chaos hook that makes a healthy worker look unreachable."""
+    faults.maybe_raise(
+        "fleet.probe",
+        lambda: ConnectionError("injected fleet.probe fault"))
+    status, payload = request_json(host, port, "GET", "/healthz",
+                                   timeout_s=timeout_s)
+    if status != 200:
+        raise ConnectionError(f"/healthz answered {status}")
+    if not isinstance(payload, dict):
+        raise ConnectionError("/healthz payload is not an object")
+    return payload
+
+
+def stub_worker_cmd(worker_id: str, port: int, heartbeat_path: str,
+                    overrides: Dict[str, Any]) -> List[str]:
+    """Command factory for ``serving/worker_stub.py`` rehearsal workers
+    (fleet chaos tests, ``cli/serve.py --fleet_stub_workers``, bench's
+    ``rollover`` section). ``overrides`` keys map onto stub flags;
+    ``ckpt_name`` aliases onto the stub's weights signature so rollover
+    requests written against real workers rehearse unchanged."""
+    cmd = [sys.executable, "-m", "deepinteract_tpu.serving.worker_stub",
+           "--worker_id", worker_id, "--port", str(port),
+           "--parent_pid", str(os.getpid())]
+    if heartbeat_path:
+        cmd += ["--heartbeat_file", heartbeat_path]
+    # ckpt_name outranks a base weights_signature: a rollover that only
+    # names the new checkpoint must repoint the stub's identity even
+    # when the fleet was configured with a baseline signature.
+    sig = overrides.get("ckpt_name") or overrides.get("weights_signature")
+    if sig:
+        cmd += ["--weights_signature", str(sig)]
+    for key in ("warm_buckets", "delay_ms", "warm_after_s",
+                "crash_after_s", "heartbeat_interval_s"):
+        if key in overrides:
+            cmd += [f"--{key}", str(overrides[key])]
+    return cmd
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Supervision policy (CLI surface: ``cli/serve.py`` fleet flags)."""
+
+    num_workers: int = 2
+    # Monitor cadence + probe transport bound.
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    # Heartbeat staleness: past max_age the worker is unroutable; past
+    # wedge_kill_factor * max_age with a LIVE process it is wedged (beat
+    # thread or event loop stuck) and gets SIGKILLed into the restart
+    # path. 0 disables heartbeat checks (probe-only supervision).
+    heartbeat_max_age_s: float = 15.0
+    wedge_kill_factor: float = 3.0
+    # PR-1 exponential backoff between restart attempts.
+    restart_backoff_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    # Circuit breaker: more than this many restarts inside the window
+    # stops the restart loop for that worker (operator action required).
+    circuit_max_restarts: int = 5
+    circuit_window_s: float = 60.0
+    # A worker still not probing healthy this long after its spawn is
+    # stuck BEFORE it could even start beating (deadlocked import,
+    # wedged checkpoint mount): SIGKILL it into the restart path. Must
+    # comfortably exceed a real worker's restore+AOT warmup; 0
+    # disables.
+    start_grace_s: float = 600.0
+    # Heartbeats, per-worker logs, and fleet_state.json live here.
+    state_dir: str = ""
+    # SIGTERM-drain grace before the SIGKILL fallback at stop/retire.
+    drain_timeout_s: float = 30.0
+
+
+class _Worker:
+    """Mutable per-worker record. Every field is guarded by the owning
+    supervisor's ``_lock``; the Popen handle itself is only ever driven
+    (signal/wait) outside the lock via a snapshot reference."""
+
+    def __init__(self, worker_id: str, port: int, heartbeat_path: str,
+                 log_path: str, overrides: Dict[str, Any]):
+        self.worker_id = worker_id
+        self.port = port
+        self.heartbeat_path = heartbeat_path
+        self.log_path = log_path
+        self.overrides = dict(overrides)
+        self.proc: Optional[subprocess.Popen] = None
+        # spawning -> starting -> healthy <-> unhealthy; dead ->
+        # restarting -> spawning; circuit_open, draining, retired are
+        # terminal-ish. Registered as "spawning" (not "starting"): the
+        # monitor must not classify a worker whose FIRST Popen is still
+        # in flight as dead and double-spawn it.
+        self.state = "spawning"
+        self.restarts = 0
+        self.restart_times: deque = deque()
+        self.backoff_attempt = 0
+        self.next_restart_at = 0.0
+        self.last_exit_code: Optional[int] = None
+        self.last_error = ""
+        self.health: Dict[str, Any] = {}
+        self.heartbeat = "unknown"
+        self.spawned_at = 0.0  # monotonic stamp of the last spawn
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "worker_id": self.worker_id,
+            "port": self.port,
+            "pid": self.proc.pid if self.proc is not None else None,
+            "state": self.state,
+            "restarts": self.restarts,
+            "last_exit_code": self.last_exit_code,
+            "last_error": self.last_error,
+            "heartbeat": self.heartbeat,
+            "health": dict(self.health),
+            "log_path": self.log_path,
+        }
+
+
+class WorkerSupervisor:
+    """Spawn/monitor/restart N worker processes (module docstring)."""
+
+    def __init__(self, cmd_fn: CmdFn, cfg: FleetConfig = FleetConfig(),
+                 host: str = "127.0.0.1",
+                 overrides: Optional[Dict[str, Any]] = None):
+        if cfg.num_workers < 0:
+            raise ValueError(f"num_workers must be >= 0, got "
+                             f"{cfg.num_workers}")
+        self.cfg = cfg
+        self.host = host
+        self._cmd_fn = cmd_fn
+        self._base_overrides = dict(overrides or {})
+        # RLock so lookup helpers can guard their reads explicitly (a
+        # verifiable no-cost re-entry under callers already holding it —
+        # the scheduler's _take_ready_group discipline).
+        self._lock = threading.RLock()
+        self._workers: Dict[str, _Worker] = {}
+        self._seq = 0
+        self._started = False
+        self._restarts_total = 0
+        # Cumulative circuit trips: retirement (e.g. the shutdown
+        # drain) clears a worker's OPEN state, but the final fleet/v1
+        # contract must still report that supervision degraded during
+        # the run — "ok" would otherwise be vacuously true at exit.
+        self._circuit_tripped = 0
+        self._stop = threading.Event()
+        self._persist_lock = threading.Lock()
+        self._monitor: Optional[threading.Thread] = None
+        # Absolute: worker paths (heartbeat, log) are handed to child
+        # processes and must not depend on anyone's cwd.
+        state_dir = os.path.abspath(cfg.state_dir or os.path.join(
+            os.getcwd(), "fleet_state"))
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_dir = state_dir
+        self.state_path = os.path.join(state_dir, "fleet_state.json")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "WorkerSupervisor":
+        """Spawn the initial fleet and the monitor. IDEMPOTENT: the
+        router calls it defensively, and a caller that already started
+        the supervisor must not get a second fleet."""
+        with self._lock:
+            spawn_initial = not self._started
+            self._started = True
+        if spawn_initial:
+            for _ in range(self.cfg.num_workers):
+                self.spawn_worker(self._base_overrides)
+        if self._monitor is None:
+            self._stop.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="fleet-monitor", daemon=True)
+            self._monitor.start()
+        return self
+
+    def stop(self, timeout_s: Optional[float] = None) -> Dict[str, Optional[int]]:
+        """Drain every non-retired worker (SIGTERM -> wait -> SIGKILL
+        fallback) and stop the monitor. Returns worker -> exit code."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        with self._lock:
+            ids = [w.worker_id for w in self._workers.values()
+                   if w.state != "retired"]
+        codes = self.drain_many(
+            ids, timeout_s if timeout_s is not None
+            else self.cfg.drain_timeout_s)
+        self._persist_state()
+        return codes
+
+    def drain_many(self, worker_ids: Sequence[str],
+                   timeout_s: float) -> Dict[str, Optional[int]]:
+        """Drain several workers IN PARALLEL (one thread each): N x
+        drain_timeout_s sequential could outlive a preemption grace
+        window or a rollover client's socket budget. The one drain
+        fan-out stop(), rollover success, and rollover abort share."""
+        return fan_out(
+            {wid: (lambda w=wid: self.drain_worker(w, timeout_s))
+             for wid in worker_ids}, name="drain")
+
+    # -- spawning ----------------------------------------------------------
+
+    def spawn_worker(self, overrides: Optional[Dict[str, Any]] = None) -> str:
+        """Create + spawn one new worker; returns its id. A failed spawn
+        still registers the worker (state ``restarting``) so the monitor
+        retries it with backoff instead of silently shrinking the
+        fleet."""
+        if self._stop.is_set():
+            # A rollover (e.g. SIGHUP) racing shutdown must not spawn
+            # workers AFTER stop()'s drain snapshot — they would run
+            # unsupervised and undrained.
+            raise RuntimeError("supervisor is stopping; refusing to "
+                               "spawn new workers")
+        with self._lock:
+            self._seq += 1
+            worker_id = f"w{self._seq}"
+            port = free_port(self.host)
+            w = _Worker(
+                worker_id, port,
+                heartbeat_path=os.path.join(
+                    self.state_dir, f"heartbeat_{worker_id}.json"),
+                log_path=os.path.join(self.state_dir, f"{worker_id}.log"),
+                overrides={**self._base_overrides, **(overrides or {})})
+            self._workers[worker_id] = w
+        self._try_spawn(w, first=True)
+        self._update_gauges()
+        self._persist_state()
+        return worker_id
+
+    def spawn_replacements(self, n: int,
+                           overrides: Optional[Dict[str, Any]] = None
+                           ) -> List[str]:
+        """Rollover entry: ``n`` fresh workers with override knobs (new
+        checkpoint / target signature) layered over the fleet's base."""
+        return [self.spawn_worker(overrides) for _ in range(n)]
+
+    @staticmethod
+    def _prune_restart_window(w: _Worker, now: float,
+                              window_s: float) -> None:
+        """Drop restart/spawn-attempt stamps older than the sliding
+        circuit window (caller holds the lock). ONE implementation so
+        the spawn-failure, respawn, and crash paths cannot drift."""
+        while w.restart_times and now - w.restart_times[0] > window_s:
+            w.restart_times.popleft()
+
+    def _try_spawn(self, w: _Worker, first: bool = False) -> bool:
+        """Spawn (or respawn) ``w``'s process. Popen runs OUTSIDE the
+        lock (it forks); state transitions re-acquire it. EVERY
+        pre-exec step runs inside the failure handling: an exception
+        that escaped here would strand the worker in state "spawning",
+        which nothing retries."""
+        if self._stop.is_set():
+            with self._lock:
+                w.state = "restarting"  # shutdown drain will retire it
+            return False
+        try:
+            if not first:
+                # Fresh port per respawn: the old port may have been
+                # taken while the worker sat in backoff (or the bind-0
+                # race was lost), and retrying a doomed port would
+                # convert a transient conflict into a circuit-open
+                # worker. Everything downstream (endpoint(), probes)
+                # reads w.port live.
+                with self._lock:
+                    w.port = free_port(self.host)
+            cmd = self._cmd_fn(w.worker_id, w.port, w.heartbeat_path,
+                               w.overrides)
+            # The PREVIOUS incarnation's heartbeat must not outlive it:
+            # a real engine worker beats only after checkpoint restore
+            # + AOT warmup, and a leftover stale file would read as
+            # "wedged" during that window — the wedge-killer would
+            # SIGKILL every warming respawn until the circuit opened.
+            try:
+                os.unlink(w.heartbeat_path)
+            except OSError:
+                pass
+            faults.maybe_raise(
+                "fleet.spawn",
+                lambda: OSError("injected fleet.spawn fault"))
+            # Streaming child log, append-only and regenerable — the
+            # integrity-sidecar regime is for state, not stdout.
+            log = open(w.log_path, "ab")  # di: allow[artifact-write] streaming child-process log (append-only, regenerable)
+            try:
+                # cwd is INHERITED: the worker argv may carry relative
+                # paths (--ckpt_name checkpoints/run1) that must resolve
+                # exactly as they would for the operator's own process.
+                proc = subprocess.Popen(
+                    cmd, stdout=log, stderr=subprocess.STDOUT)
+            finally:
+                log.close()
+        except Exception as exc:  # noqa: BLE001 - any pre-exec failure
+            _SPAWN_FAILURES.inc(worker=w.worker_id)
+            with self._lock:
+                w.last_error = f"spawn failed: {exc}"
+                # Failed spawn ATTEMPTS count toward the circuit like
+                # successful respawns do: a persistently unspawnable
+                # worker (missing binary, unopenable log path) must trip
+                # the breaker, not spawn-retry forever while the fleet
+                # contract reports ok.
+                now = time.monotonic()
+                w.restart_times.append(now)
+                self._prune_restart_window(w, now,
+                                           self.cfg.circuit_window_s)
+                if (not first and len(w.restart_times)
+                        >= self.cfg.circuit_max_restarts):
+                    w.state = "circuit_open"
+                    self._circuit_tripped += 1
+                    logger.error(
+                        "fleet: %s failed %d spawn/restart attempts "
+                        "inside %.0fs — circuit OPEN (inspect %s)",
+                        w.worker_id, len(w.restart_times),
+                        self.cfg.circuit_window_s, w.log_path)
+                    return False
+                w.state = "restarting"
+                w.next_restart_at = now + compute_delay(
+                    w.backoff_attempt, self.cfg.restart_backoff_s,
+                    self.cfg.restart_backoff_max_s)
+                w.backoff_attempt += 1
+            logger.error("fleet: spawning %s failed (%s); retrying with "
+                         "backoff", w.worker_id, exc)
+            return False
+        with self._lock:
+            if w.state in ("draining", "retired"):
+                # A concurrent stop/rollover-abort retired this worker
+                # while Popen ran outside the lock: the fresh process
+                # must not outlive the decision. Kill it unsupervised-
+                # never.
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+                logger.warning("fleet: %s was retired mid-spawn; killed "
+                               "the fresh process", w.worker_id)
+                return False
+            w.proc = proc
+            w.state = "starting"
+            w.last_error = ""
+            w.spawned_at = time.monotonic()
+            if not first:
+                w.restarts += 1
+                self._restarts_total += 1
+                now = time.monotonic()
+                w.restart_times.append(now)
+                self._prune_restart_window(w, now,
+                                           self.cfg.circuit_window_s)
+        if not first:
+            _RESTARTS.inc(worker=w.worker_id)
+            logger.warning("fleet: restarted %s (pid %d, restart #%d)",
+                           w.worker_id, proc.pid, w.restarts)
+        return True
+
+    # -- monitoring --------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - monitor must survive
+                logger.exception("fleet monitor tick failed")
+            self._stop.wait(self.cfg.probe_interval_s)
+
+    def poll_once(self) -> None:
+        """One supervision tick: liveness, restarts, probes. Public (and
+        re-entrant-safe) so the router's rollover warm-wait and the
+        tests can drive supervision deterministically instead of
+        sleeping against the monitor cadence."""
+        now = time.monotonic()
+        with self._lock:
+            workers = [w for w in self._workers.values()
+                       if w.state not in ("retired",)]
+        changed = False
+        to_probe: List[_Worker] = []
+        for w in workers:
+            with self._lock:
+                proc, state = w.proc, w.state
+            if state == "draining":
+                continue
+            rc = proc.poll() if proc is not None else None
+            if proc is None or rc is not None:
+                changed |= self._handle_down(w, rc, now)
+                continue
+            to_probe.append(w)
+        # Probes run CONCURRENTLY: one black-holed worker burning its
+        # full probe_timeout_s must not delay crash detection for the
+        # rest of the fleet (nor serialize the rollover warm-wait,
+        # which ticks this method in a tight loop).
+        if len(to_probe) == 1:
+            changed |= self._probe(to_probe[0])
+        elif to_probe:
+            results = fan_out(
+                {w.worker_id: (lambda ww=w: self._probe(ww))
+                 for w in to_probe},
+                join_timeout_s=self.cfg.probe_timeout_s + 2.0,
+                name="probe")
+            changed |= any(results.values())
+        if changed:
+            self._persist_state()
+        self._update_gauges()
+
+    def _handle_down(self, w: _Worker, rc: Optional[int],
+                     now: float) -> bool:
+        """``w``'s process is gone (or never spawned). Classify, maybe
+        trip the circuit, maybe respawn."""
+        respawn = False
+        with self._lock:
+            if w.state in ("circuit_open", "spawning", "draining",
+                           "retired"):
+                # draining/retired re-checked UNDER the lock: poll_once
+                # snapshots states before its per-worker work, and a
+                # drain landing in between must not be re-read as an
+                # unexpected death (which would respawn a worker someone
+                # just retired).
+                return False
+            if w.state not in ("dead", "restarting"):
+                w.last_exit_code = rc
+                w.state = "dead"
+                w.last_error = f"process exited rc={rc}"
+                logger.error("fleet: worker %s died (rc=%s)",
+                             w.worker_id, rc)
+                # Prune at CHECK time, not only at respawn time: a
+                # worker that flapped hours ago and then served
+                # healthily must not trip the circuit on its next
+                # ordinary crash — the window is a sliding one.
+                self._prune_restart_window(w, now,
+                                           self.cfg.circuit_window_s)
+                if len(w.restart_times) >= self.cfg.circuit_max_restarts:
+                    w.state = "circuit_open"
+                    self._circuit_tripped += 1
+                    logger.error(
+                        "fleet: %s restarted %d times inside %.0fs — "
+                        "circuit OPEN, no further restarts (inspect %s)",
+                        w.worker_id, len(w.restart_times),
+                        self.cfg.circuit_window_s, w.log_path)
+                    return True
+                w.next_restart_at = now + compute_delay(
+                    w.backoff_attempt, self.cfg.restart_backoff_s,
+                    self.cfg.restart_backoff_max_s)
+                w.backoff_attempt += 1
+                w.state = "restarting"
+                return True
+            if w.state == "restarting" and now >= w.next_restart_at:
+                # Claim the respawn while holding the lock: poll_once
+                # runs on the monitor thread AND from a rollover's
+                # warm-wait, and a doubly-spawned worker would leak a
+                # process nothing supervises.
+                w.state = "spawning"
+                respawn = True
+        if respawn:
+            self._try_spawn(w)
+            return True
+        return False
+
+    def _probe(self, w: _Worker) -> bool:
+        """Health-probe a live worker: /healthz + heartbeat freshness.
+        Network I/O runs outside the lock."""
+        hb: Optional[HeartbeatStatus] = None
+        if w.heartbeat_path and self.cfg.heartbeat_max_age_s > 0:
+            hb = read_heartbeat(w.heartbeat_path,
+                                self.cfg.heartbeat_max_age_s)
+        try:
+            health = probe_healthz(self.host, w.port,
+                                   timeout_s=self.cfg.probe_timeout_s)
+            probe_error = ""
+        except Exception as exc:  # noqa: BLE001 - classified below
+            health = None
+            probe_error = str(exc)
+            _PROBE_FAILURES.inc(worker=w.worker_id)
+        wedged = (hb is not None and hb.status == "stale"
+                  and hb.age_s is not None
+                  and hb.age_s > self.cfg.heartbeat_max_age_s
+                  * self.cfg.wedge_kill_factor)
+        with self._lock:
+            spawned_at, state_now = w.spawned_at, w.state
+        beating = hb is not None and hb.status == "fresh"
+        if (not wedged and not beating and self.cfg.start_grace_s > 0
+                and state_now in ("starting", "unhealthy")
+                and health is None and spawned_at > 0
+                and time.monotonic() - spawned_at
+                > self.cfg.start_grace_s):
+            # "not beating": a fresh heartbeat proves the process is
+            # alive and making progress (a slow warmup legitimately
+            # exceeds any fixed grace — engine workers beat BEFORE
+            # restore starts); the grace kill is for workers that hung
+            # before they could even start the beat thread.
+            # Never-came-up wedge: alive past the whole start grace but
+            # still unprobeable AND (possibly) never wrote a heartbeat
+            # — the stale-beat detector can't see a worker that hung
+            # before its first beat, so the grace bound catches it.
+            wedged = True
+            logger.error(
+                "fleet: %s still not healthy %.0fs after spawn "
+                "(unprobeable) — SIGKILL for restart", w.worker_id,
+                time.monotonic() - spawned_at)
+        changed = False
+        with self._lock:
+            if w.state in ("draining", "retired"):
+                # A drain won the race against this probe's network I/O:
+                # a stale success must not resurrect a retired worker
+                # (the next tick would respawn it with the OLD weights).
+                return False
+            prev = w.state
+            w.heartbeat = hb.status if hb is not None else "disabled"
+            if health is not None:
+                w.health = health
+                stale = hb is not None and hb.status == "stale"
+                routable = health.get("status") in ("ok", "overloaded")
+                w.state = ("healthy" if routable and not stale
+                           else "unhealthy" if stale else "starting"
+                           if health.get("status") == "warming"
+                           else "unhealthy")
+                if w.state == "healthy":
+                    w.backoff_attempt = 0
+                    w.last_error = ""
+                elif stale:
+                    w.last_error = (f"heartbeat stale "
+                                    f"({hb.age_s:.1f}s old)")
+            else:
+                w.last_error = f"probe failed: {probe_error}"
+                if w.state == "healthy":
+                    w.state = "unhealthy"
+            changed = w.state != prev
+        if wedged:
+            _WEDGE_KILLS.inc(worker=w.worker_id)
+            logger.error(
+                "fleet: %s is live but wedged (heartbeat %s) — SIGKILL "
+                "for restart", w.worker_id,
+                f"{hb.age_s:.1f}s stale"
+                if hb is not None and hb.age_s is not None
+                else "never written")
+            self._signal(w, signal.SIGKILL)
+            changed = True
+        return changed
+
+    # -- stopping / retiring ----------------------------------------------
+
+    def _signal(self, w: _Worker, sig: int) -> bool:
+        """Deliver ``sig`` to ``w``'s process. ``fleet.kill`` is the
+        chaos hook for a failed delivery (e.g. a PID namespace surprise)
+        — callers must keep a fallback path."""
+        with self._lock:
+            proc = w.proc
+        if proc is None or proc.poll() is not None:
+            return False
+        try:
+            faults.maybe_raise(
+                "fleet.kill", lambda: OSError("injected fleet.kill fault"))
+            proc.send_signal(sig)
+            return True
+        except OSError as exc:
+            with self._lock:
+                w.last_error = f"signal {sig} failed: {exc}"
+            logger.error("fleet: signalling %s with %s failed: %s",
+                         w.worker_id, sig, exc)
+            return False
+
+    def drain_worker(self, worker_id: str,
+                     timeout_s: float = 30.0) -> Optional[int]:
+        """SIGTERM-drain a worker (its own PR-1 drain path finishes
+        in-flight work and exits 0), SIGKILL past the grace, retire it
+        either way. Returns the exit code (None if it never ran)."""
+        w = self._get(worker_id)
+        with self._lock:
+            w.state = "draining"
+            proc = w.proc
+        self._persist_state()
+        rc: Optional[int] = None
+        if proc is not None:
+            terminated = self._signal(w, signal.SIGTERM)
+            try:
+                rc = proc.wait(timeout=timeout_s if terminated else 0.5)
+            except subprocess.TimeoutExpired:
+                logger.error("fleet: %s ignored SIGTERM for %.0fs — "
+                             "SIGKILL", worker_id, timeout_s)
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+                try:
+                    rc = proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    rc = None
+            if rc is None and terminated is False:
+                # SIGTERM delivery itself failed (fleet.kill chaos):
+                # fall back to SIGKILL so retire is unconditional.
+                try:
+                    proc.kill()
+                    rc = proc.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    rc = None
+        with self._lock:
+            w.last_exit_code = rc
+            w.state = "retired"
+            self._gc_retired_locked()
+        self._update_gauges()
+        self._persist_state()
+        return rc
+
+    def _gc_retired_locked(self) -> None:
+        """Drop the oldest retired records beyond RETIRED_RETENTION
+        (registration order approximates retirement order well enough
+        for a debugging window), INCLUDING their per-worker metric
+        series — without this, daily rollovers would grow the scrape
+        with dead worker labels forever."""
+        with self._lock:  # re-entrant: callers already hold it
+            retired = [w.worker_id for w in self._workers.values()
+                       if w.state == "retired"]
+            dropped = retired[:max(0, len(retired) - RETIRED_RETENTION)]
+            for worker_id in dropped:
+                del self._workers[worker_id]
+        for worker_id in dropped:
+            for family in (_UP, _CIRCUIT, _RESTARTS, _SPAWN_FAILURES,
+                           _PROBE_FAILURES, _WEDGE_KILLS):
+                family.remove(worker=worker_id)
+
+    def kill_worker(self, worker_id: str) -> None:
+        """SIGKILL (chaos / operator hammer); the monitor's normal
+        crash-restart path picks up the corpse."""
+        self._signal(self._get(worker_id), signal.SIGKILL)
+
+    # -- queries -----------------------------------------------------------
+
+    def _get(self, worker_id: str) -> _Worker:
+        with self._lock:
+            return self._get_locked(worker_id)
+
+    def _get_locked(self, worker_id: str) -> _Worker:
+        with self._lock:  # re-entrant: callers already hold it
+            try:
+                return self._workers[worker_id]
+            except KeyError:
+                raise KeyError(f"unknown worker {worker_id!r}") from None
+
+    def worker_info(self, worker_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return self._get_locked(worker_id).snapshot()
+
+    def worker_infos(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [w.snapshot() for w in self._workers.values()]
+
+    def routable_workers(self) -> List[Dict[str, Any]]:
+        """Snapshot of workers a router may send requests to right now."""
+        with self._lock:
+            return [w.snapshot() for w in self._workers.values()
+                    if w.state == "healthy"]
+
+    def endpoint(self, worker_id: str) -> Sequence:
+        w = self._get(worker_id)
+        return self.host, w.port
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            states: Dict[str, int] = {}
+            for w in self._workers.values():
+                states[w.state] = states.get(w.state, 0) + 1
+            return {
+                "workers": {w.worker_id: w.snapshot()
+                            for w in self._workers.values()},
+                "states": states,
+                "restarts_total": self._restarts_total,
+                "circuit_open": states.get("circuit_open", 0),
+                "circuit_tripped_total": self._circuit_tripped,
+                "state_path": self.state_path,
+            }
+
+    # -- persistence / gauges ---------------------------------------------
+
+    def _persist_state(self) -> None:
+        with self._lock:
+            state = {
+                "updated_ts": time.time(),
+                "restarts_total": self._restarts_total,
+                "workers": {w.worker_id: w.snapshot()
+                            for w in self._workers.values()},
+            }
+        # Serialized: atomic_write's tmp name is pid-based, so two
+        # threads persisting concurrently (monitor tick + a drain
+        # thread) would collide on the same tmp file.
+        with self._persist_lock:
+            try:
+                artifacts.atomic_write(self.state_path,
+                                       json.dumps(state, sort_keys=True),
+                                       fsync=False)
+            except OSError as exc:
+                # A full disk must not take down supervision itself.
+                logger.error("fleet: persisting %s failed: %s",
+                             self.state_path, exc)
+
+    def _update_gauges(self) -> None:
+        with self._lock:
+            states = [(w.worker_id, w.state)
+                      for w in self._workers.values()]
+        healthy = 0
+        active = 0
+        for worker_id, state in states:
+            _UP.set(1.0 if state == "healthy" else 0.0, worker=worker_id)
+            _CIRCUIT.set(1.0 if state == "circuit_open" else 0.0,
+                         worker=worker_id)
+            healthy += state == "healthy"
+            active += state not in ("retired",)
+        _WORKERS_TOTAL.set(float(active))
+        _WORKERS_HEALTHY.set(float(healthy))

@@ -1,0 +1,753 @@
+"""Fleet HTTP front: health-checked routing, failover, warm rollover.
+
+The router is the one address clients know. Behind it, a
+:class:`~deepinteract_tpu.serving.fleet.WorkerSupervisor` keeps N
+single-engine workers alive; the router:
+
+* **routes** — ``POST /predict`` / ``POST /screen`` are proxied to a
+  healthy worker. Same-bucket requests stick to the same worker while
+  the fleet is stable (an ``X-DI-Bucket`` hint is hashed onto the active
+  list, so a bucket's compile cache and micro-batch coalescing stay
+  warm on ONE worker) and fall back to round-robin without a hint. The
+  answering worker is echoed in the ``X-DI-Worker`` response header.
+* **fails over** — ``predict``/``screen`` are pure functions of the
+  request, so when a worker dies mid-flight (connection refused/reset,
+  torn response) or answers 503-draining, the SAME request is retried on
+  a sibling — bounded by the PR-11 request deadline
+  (``X-Request-Deadline-Ms`` forwarded with the REMAINING budget) and by
+  one attempt per distinct healthy worker. Worker application errors
+  (400/500 with an intact response) pass through untouched: the worker
+  answered; re-asking a sibling would just re-execute a bad request.
+* **aggregates** — ``GET /stats`` merges the supervisor's fleet view
+  with every worker's own ``/stats``; ``GET /metrics`` renders the
+  router's registry plus every live worker's exposition with a
+  ``worker="wN"`` label injected into the ``di_*`` families (one merged
+  family block per metric, so the scrape stays valid Prometheus text);
+  ``GET /healthz`` is the fleet's liveness page.
+* **rolls over** — ``POST /admin/rollover`` (or SIGHUP) performs a
+  zero-downtime weights/config update: spawn replacement workers (with
+  e.g. a new ``ckpt_name``), wait until each reports **warm** on
+  ``/healthz`` (``status: ok``, ``warm_buckets`` covering the configured
+  prefixes, ``weights_signature`` matching the target when one is
+  given), atomically swap the routing table, then SIGTERM-drain the old
+  workers through their own PR-1/PR-11 drain path. In-flight requests
+  finish on the old workers; requests racing the swap fail over to the
+  new ones; nothing is dropped and no client ever hits a cold compile.
+  A replacement that never warms ABORTS the rollover (replacements are
+  killed, the old fleet keeps serving) — rollover is all-or-nothing.
+
+The rollover response and the router's final stdout line (printed by
+``cli/serve.py``) share the machine-readable ``fleet/v1`` contract
+(``tools/check_cli_contract.py`` kind ``fleet``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import re
+import signal
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepinteract_tpu.obs import expfmt
+from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.robustness.preemption import PreemptionGuard
+from deepinteract_tpu.serving.admission import Deadline
+from deepinteract_tpu.serving.fleet import (
+    QuietHTTPServer,
+    WorkerSupervisor,
+    endpoint_label,
+    fan_out,
+    request_json,
+)
+
+logger = logging.getLogger(__name__)
+
+_ROUTED = obs_metrics.counter(
+    "di_fleet_routed_total", "Requests answered through the router",
+    labelnames=("endpoint", "status"))
+_FAILOVERS = obs_metrics.counter(
+    "di_fleet_failovers_total",
+    "Requests retried on a sibling after a worker failed mid-flight",
+    labelnames=("reason",))
+_ROLLOVERS = obs_metrics.counter(
+    "di_fleet_rollovers_total", "Warm rollovers", labelnames=("outcome",))
+
+
+class RolloverFailed(RuntimeError):
+    """A rollover aborted (replacements never warmed / already rolling).
+    The OLD fleet keeps serving — failure is never downtime."""
+
+
+class RolloverBusy(RolloverFailed):
+    """A rollover is already in progress (HTTP 409 — retry later). A
+    TYPE, not a message substring, so rewording can't break the status
+    mapping."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Routing + rollover policy (CLI surface: ``cli/serve.py``)."""
+
+    # Per-attempt proxy bound when the request carries no deadline.
+    proxy_timeout_s: float = 120.0
+    # Deadline applied when the client sends none (0 = none; then
+    # proxy_timeout_s is the only bound) — mirrors the worker flag.
+    default_deadline_ms: float = 0.0
+    # Compile-inventory label prefixes a replacement must report in
+    # /healthz warm_buckets before a rollover may switch to it
+    # (e.g. ("128x128/",) from --warmup_buckets). Empty = status ok
+    # (+ signature match) is warm enough.
+    required_warm_buckets: Tuple[str, ...] = ()
+    # Bound on the replacement warm-up wait before a rollover aborts.
+    warm_timeout_s: float = 300.0
+    # SIGTERM-drain grace for the old workers after the routing swap.
+    drain_timeout_s: float = 60.0
+    # Short transport bound for /stats//metrics aggregation fetches.
+    aggregate_timeout_s: float = 3.0
+
+
+class FleetRouter:
+    """Supervisor-backed HTTP front (module docstring)."""
+
+    def __init__(self, supervisor: WorkerSupervisor,
+                 host: str = "127.0.0.1", port: int = 0,
+                 cfg: RouterConfig = RouterConfig()):
+        self.sup = supervisor
+        self.cfg = cfg
+        self._draining = threading.Event()
+        self._lock = threading.Lock()
+        # Worker ids eligible for routing; swapped atomically by
+        # rollover. Retired/unknown ids are filtered at pick time
+        # against the supervisor's live states.
+        self._active: List[str] = []
+        self._rr = 0
+        self._routed = 0
+        self._failovers = 0
+        self._rollovers = 0
+        # One rollover at a time; a second request answers 409. The
+        # separate _rollover_active flag (under _lock) is what /healthz
+        # reports — probing the mutex itself from health() could make a
+        # real rollover spuriously 409.
+        self._rollover_lock = threading.Lock()
+        self._rollover_active = False
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+                logger.debug("router http: " + fmt, *args)
+
+            def _send_body(self, code: int, body: bytes, ctype: str,
+                           extra: Optional[Dict[str, str]] = None) -> None:
+                _ROUTED.inc(endpoint=endpoint_label(
+                    self.path, ("/predict", "/screen", "/healthz",
+                                "/stats", "/metrics", "/admin/rollover")),
+                    status=str(code))
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for name, value in (extra or {}).items():
+                    self.send_header(name, value)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, payload: Dict,
+                           extra: Optional[Dict[str, str]] = None) -> None:
+                self._send_body(code, json.dumps(payload).encode(),
+                                "application/json", extra=extra)
+
+            def do_GET(self):  # noqa: N802 - stdlib name
+                route = self.path.partition("?")[0]
+                if route == "/healthz":
+                    self._send_json(200, router.health())
+                elif route == "/stats":
+                    self._send_json(200, router.stats())
+                elif route == "/metrics":
+                    self._send_body(200, router.metrics_text().encode(),
+                                    expfmt.CONTENT_TYPE)
+                else:
+                    self._send_json(404, {"error": f"no route {route}"})
+
+            def do_POST(self):  # noqa: N802 - stdlib name
+                route = self.path.partition("?")[0]
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if route == "/admin/rollover":
+                    self._do_rollover(body)
+                    return
+                if route not in ("/predict", "/screen"):
+                    self._send_json(404, {"error": f"no route {route}"})
+                    return
+                if router._draining.is_set():
+                    self._send_json(503, {"error": "router is draining"})
+                    return
+                try:
+                    deadline = self._deadline()
+                except ValueError as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                status, out, headers = router.proxy(
+                    "POST", self.path, body,
+                    content_type=self.headers.get(
+                        "Content-Type", "application/octet-stream"),
+                    bucket_hint=self.headers.get("X-DI-Bucket"),
+                    deadline=deadline)
+                self._send_body(status, out,
+                                headers.pop("Content-Type",
+                                            "application/json"),
+                                extra=headers)
+
+            def _deadline(self) -> Optional[Deadline]:
+                hdr = self.headers.get("X-Request-Deadline-Ms")
+                if hdr is not None:
+                    ms = float(hdr)
+                    if not ms > 0:
+                        raise ValueError(
+                            f"X-Request-Deadline-Ms must be > 0, got "
+                            f"{hdr!r}")
+                    return Deadline.after(ms / 1e3)
+                if router.cfg.default_deadline_ms > 0:
+                    return Deadline.after(
+                        router.cfg.default_deadline_ms / 1e3)
+                return None
+
+            def _do_rollover(self, body: bytes) -> None:
+                try:
+                    overrides = json.loads(body.decode()) if body else {}
+                    if not isinstance(overrides, dict):
+                        raise ValueError(
+                            "rollover body must be a JSON object")
+                except ValueError as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                try:
+                    record = router.rollover(overrides)
+                except RolloverFailed as exc:
+                    self._send_json(
+                        409 if isinstance(exc, RolloverBusy) else 500,
+                        {**router.final_contract(),
+                         "error": str(exc), "ok": False})
+                    return
+                self._send_json(200, {**router.final_contract(),
+                                      "rollover": record})
+
+        self.httpd = QuietHTTPServer((host, port), Handler)
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self.httpd.server_address[:2]
+        return str(host), int(port)
+
+    def start(self) -> "FleetRouter":
+        """Spawn the fleet (if not already started) and start accepting
+        connections. The routing table adopts every current worker;
+        routability is still gated per request on live health."""
+        self.sup.start()
+        with self._lock:
+            if not self._active:
+                self._active = [w["worker_id"]
+                                for w in self.sup.worker_infos()
+                                if w["state"] != "retired"]
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fleet-router",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def drain(self) -> None:
+        """Stop accepting, stop the listener, drain every worker."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        self.httpd.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10.0)
+        self.httpd.server_close()
+        self.sup.stop()
+
+    def run(self, guard: Optional[PreemptionGuard] = None,
+            poll_seconds: float = 0.25) -> int:
+        """Blocking serve loop with the PR-1 preemption discipline, plus
+        SIGHUP = warm rollover (the classic reload signal)."""
+        own_guard = guard is None
+        guard = guard or PreemptionGuard(log=logger.warning)
+        if own_guard:
+            guard.__enter__()
+        self._install_sighup()
+        try:
+            host, port = self.address
+            logger.info(
+                "fleet router on http://%s:%d (POST /predict, POST "
+                "/screen, POST /admin/rollover, GET /healthz, GET "
+                "/stats, GET /metrics; SIGHUP = rollover)", host, port)
+            while not guard.requested:
+                time.sleep(poll_seconds)
+            logger.warning("drain requested (%s): stopping router and "
+                           "draining %d worker(s)", guard.reason,
+                           len(self.sup.worker_infos()))
+        finally:
+            self.drain()
+            if own_guard:
+                guard.__exit__(None, None, None)
+        return 0
+
+    def _install_sighup(self) -> None:
+        def _on_hup(*_):
+            def _roll():
+                try:
+                    self.rollover({})
+                except RolloverFailed as exc:
+                    logger.error("SIGHUP rollover failed: %s", exc)
+
+            threading.Thread(target=_roll, name="sighup-rollover",
+                             daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGHUP, _on_hup)
+        except (ValueError, AttributeError, OSError):
+            # Not the main thread (tests) or no SIGHUP (platform):
+            # /admin/rollover is the portable path.
+            logger.debug("SIGHUP rollover handler not installed")
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick_sequence(self, bucket_hint: Optional[str]) -> List[str]:
+        """Failover-ordered candidate workers: every routable worker at
+        most once, starting from the bucket-affine (or round-robin)
+        choice."""
+        routable = {w["worker_id"] for w in self.sup.routable_workers()}
+        with self._lock:
+            candidates = [wid for wid in self._active if wid in routable]
+            if not candidates:
+                return []
+            if bucket_hint:
+                start = zlib.crc32(bucket_hint.encode()) % len(candidates)
+            else:
+                start = self._rr % len(candidates)
+                self._rr += 1
+            return candidates[start:] + candidates[:start]
+
+    def proxy(self, method: str, path: str, body: bytes,
+              content_type: str = "application/json",
+              bucket_hint: Optional[str] = None,
+              deadline: Optional[Deadline] = None,
+              ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Forward one idempotent request, failing over across siblings.
+        Returns (status, body, response headers). After exhausting the
+        candidate list, ONE re-pick: a request that raced a rollover's
+        routing swap may have frozen the OLD (now-draining) workers as
+        its candidates while warm replacements exist — the second pick
+        reads the post-swap table, keeping the zero-dropped contract.
+        When every candidate answered a worker-side 500 (a transient
+        batch failure — 'safe to retry' per the PR-11 contract), the
+        LAST such response is returned rather than a misleading
+        no-healthy-worker 503."""
+        attempts: List[str] = []
+        last_500: List[Tuple[int, bytes, Dict[str, str]]] = []
+        sequence = self._pick_sequence(bucket_hint)
+        for round_no in (1, 2):
+            if round_no == 2:
+                refreshed = self._pick_sequence(bucket_hint)
+                sequence = [wid for wid in refreshed
+                            if wid not in attempts]
+                if not sequence:
+                    break
+            status_out = self._proxy_round(
+                sequence, attempts, method, path, body, content_type,
+                deadline, last_500)
+            if status_out is not None:
+                return status_out
+        if last_500:
+            return self._count(*last_500[-1])
+        retry_after = 1.0
+        return self._count(503, json.dumps({
+            "error": "no healthy worker available"
+                     + (f" (attempted {attempts})" if attempts else ""),
+            "retry_after_s": retry_after,
+        }).encode(), {"Retry-After": str(int(retry_after))})
+
+    def _proxy_round(self, sequence: List[str], attempts: List[str],
+                     method: str, path: str, body: bytes,
+                     content_type: str, deadline: Optional[Deadline],
+                     last_500: List) -> Optional[Tuple]:
+        """One pass over ``sequence``; returns an answer tuple or None
+        when every candidate failed over (worker-500 responses are
+        stashed in ``last_500`` for the caller's fallback)."""
+        for worker_id in sequence:
+            if deadline is not None and deadline.expired:
+                return self._count(504, json.dumps({
+                    "error": "deadline expired while failing over",
+                    "attempted_workers": attempts}).encode(), {})
+            try:
+                host, port = self.sup.endpoint(worker_id)
+            except KeyError:
+                continue
+            timeout = self.cfg.proxy_timeout_s
+            if deadline is not None:
+                timeout = min(timeout, deadline.remaining_s() + 0.25)
+            attempts.append(worker_id)
+            try:
+                status, out, headers = self._attempt(
+                    host, port, method, path, body, content_type,
+                    deadline, timeout)
+            except Exception as exc:  # noqa: BLE001 - transport failover
+                self._note_failover(worker_id, f"transport: {exc}",
+                                    reason="transport")
+                continue
+            if status == 503:
+                # Draining/shutting-down sibling: the work was refused,
+                # not executed — the retry contract says "another
+                # replica", and the router IS the other replica's door.
+                self._note_failover(worker_id, "worker answered 503",
+                                    reason="worker_draining")
+                continue
+            if status == 500:
+                # A worker 500 is a transient batch failure
+                # (BatchExecutionError — "safe to retry" in the PR-11
+                # client contract) and predict/screen are pure: retry
+                # on a sibling, keeping the response in case every
+                # sibling fails the same way.
+                headers["X-DI-Worker"] = worker_id
+                last_500.append((status, out, headers))
+                self._note_failover(worker_id, "worker answered 500",
+                                    reason="worker_error")
+                continue
+            headers["X-DI-Worker"] = worker_id
+            if len(attempts) > 1:
+                headers["X-DI-Failovers"] = str(len(attempts) - 1)
+            return self._count(status, out, headers)
+        return None
+
+    def _count(self, status: int, body: bytes,
+               headers: Dict[str, str]) -> Tuple[int, bytes, Dict[str, str]]:
+        with self._lock:
+            self._routed += 1
+        return status, body, headers
+
+    def _note_failover(self, worker_id: str, detail: str,
+                       reason: str) -> None:
+        with self._lock:
+            self._failovers += 1
+        _FAILOVERS.inc(reason=reason)
+        logger.warning("fleet: failing over off %s (%s)", worker_id,
+                       detail)
+
+    def _attempt(self, host: str, port: int, method: str, path: str,
+                 body: bytes, content_type: str,
+                 deadline: Optional[Deadline],
+                 timeout: float) -> Tuple[int, bytes, Dict[str, str]]:
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=max(0.05, timeout))
+        try:
+            headers = {"Content-Type": content_type,
+                       "Content-Length": str(len(body))}
+            if deadline is not None:
+                headers["X-Request-Deadline-Ms"] = str(
+                    max(1.0, deadline.remaining_s() * 1e3))
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            out = resp.read()
+            passthrough = {}
+            for name in ("Retry-After", "Content-Type"):
+                value = resp.getheader(name)
+                if value is not None:
+                    passthrough[name] = value
+            return resp.status, out, passthrough
+        finally:
+            conn.close()
+
+    # -- rollover ----------------------------------------------------------
+
+    def rollover(self, overrides: Optional[Dict[str, Any]] = None) -> Dict:
+        """Zero-downtime worker replacement (module docstring). Raises
+        :class:`RolloverFailed` when replacements never warm (they are
+        killed; the old fleet keeps serving) or when another rollover is
+        already in progress."""
+        overrides = dict(overrides or {})
+        if not self._rollover_lock.acquire(blocking=False):
+            raise RolloverBusy("a rollover is already in progress")
+        with self._lock:
+            self._rollover_active = True
+        t0 = time.monotonic()
+        try:
+            target_sig = overrides.get("weights_signature")
+            with self._lock:
+                old = list(self._active)
+            n = len(old) or max(1, self.sup.cfg.num_workers)
+            new_ids: List[str] = []
+            try:
+                new_ids = self.sup.spawn_replacements(n, overrides)
+                logger.info("rollover: spawned replacement(s) %s "
+                            "(target signature: %s)", new_ids,
+                            target_sig or "<any>")
+                pending = set(new_ids)
+                warm_deadline = (time.monotonic()
+                                 + self.cfg.warm_timeout_s)
+                # Warm-wait cadence: bounded below the monitor's own
+                # interval but never a tight loop — real replacements
+                # spend minutes compiling, and hammering /healthz 20x/s
+                # fleet-wide would be pure overhead against workers
+                # that are busy warming.
+                wait_s = min(max(self.sup.cfg.probe_interval_s, 0.05),
+                             0.25)
+                while pending and time.monotonic() < warm_deadline:
+                    self.sup.poll_once()
+                    for wid in list(pending):
+                        if self._is_warm(wid, target_sig):
+                            pending.discard(wid)
+                    if pending:
+                        time.sleep(wait_s)
+                if pending:
+                    raise RolloverFailed(
+                        f"replacement(s) {sorted(pending)} not warm "
+                        f"after {self.cfg.warm_timeout_s:.0f}s — "
+                        "rollover aborted, old fleet keeps serving")
+            except BaseException as exc:
+                # ANY failure before the swap aborts all-or-nothing:
+                # already-spawned replacements must not linger under
+                # supervision (each retried rollover would strand
+                # another batch of new-weights workers).
+                if new_ids:
+                    self.sup.drain_many(new_ids, timeout_s=5.0)
+                _ROLLOVERS.inc(outcome="failed")
+                if isinstance(exc, RolloverFailed):
+                    raise
+                if not isinstance(exc, Exception):
+                    # KeyboardInterrupt/SystemExit keep their type —
+                    # cleanup done, but exit signals must not be
+                    # laundered into an ordinary failed rollover.
+                    raise
+                raise RolloverFailed(
+                    f"rollover failed before the routing swap: {exc!r} "
+                    "— replacements cleaned up, old fleet keeps "
+                    "serving") from exc
+            # The atomic moment: new picks go to the replacements; old
+            # workers only see requests already past _pick_sequence (and
+            # those either finish during the drain below or fail over).
+            with self._lock:
+                self._active = list(new_ids)
+                self._rollovers += 1
+            _ROLLOVERS.inc(outcome="ok")
+            # Parallel drains: N x drain_timeout_s sequential could
+            # outlive the rollover client's socket timeout on a wide
+            # fleet (supervisor drain_many is the shared fan-out).
+            exit_codes = self.sup.drain_many(
+                old, timeout_s=self.cfg.drain_timeout_s)
+            record = {
+                "ok": True,
+                "old_workers": old,
+                "new_workers": new_ids,
+                "drain_exit_codes": exit_codes,
+                "target_weights_signature": target_sig,
+                "elapsed_s": round(time.monotonic() - t0, 3),
+            }
+            logger.info("rollover complete: %s", record)
+            return record
+        finally:
+            with self._lock:
+                self._rollover_active = False
+            self._rollover_lock.release()
+
+    def _is_warm(self, worker_id: str,
+                 target_sig: Optional[str]) -> bool:
+        try:
+            info = self.sup.worker_info(worker_id)
+        except KeyError:
+            return False
+        health = info.get("health") or {}
+        if info["state"] != "healthy" or health.get("status") != "ok":
+            return False
+        if target_sig and health.get("weights_signature") != target_sig:
+            return False
+        warm = health.get("warm_buckets") or []
+        return all(any(str(label).startswith(req) for label in warm)
+                   for req in self.cfg.required_warm_buckets)
+
+    # -- observability -----------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        infos = self.sup.worker_infos()
+        active = [w for w in infos if w["state"] != "retired"]
+        healthy = [w for w in active if w["state"] == "healthy"]
+        draining = self._draining.is_set()
+        status = ("draining" if draining
+                  else "down" if not healthy
+                  else "ok" if len(healthy) == len(active) else "degraded")
+        with self._lock:
+            rollover_busy = self._rollover_active
+        return {
+            "status": status,
+            "role": "fleet-router",
+            "draining": draining,
+            "workers": len(active),
+            "healthy": len(healthy),
+            "rollover_in_progress": rollover_busy,
+            "weights_signatures": sorted(
+                {str(w["health"].get("weights_signature"))
+                 for w in healthy if w.get("health")}),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        worker_stats = self._fetch_workers("/stats")
+        with self._lock:
+            router = {
+                "routed": self._routed,
+                "failovers": self._failovers,
+                "rollovers": self._rollovers,
+                "active_workers": list(self._active),
+                "draining": self._draining.is_set(),
+            }
+        return {"router": router, "fleet": self.sup.stats(),
+                "workers": worker_stats}
+
+    def _fetch_workers(self, path: str) -> Dict[str, Any]:
+        """Fetch ``path`` from every non-retired worker CONCURRENTLY:
+        sequential fetches would stall a /stats or /metrics scrape by
+        aggregate_timeout_s per hung worker — blinding the operator
+        exactly when the fleet is degraded."""
+        infos = [info for info in self.sup.worker_infos()
+                 if info["state"] != "retired"]
+        results = fan_out(
+            {info["worker_id"]: (
+                lambda i=info: self._fetch_worker(i, path))
+             for info in infos},
+            join_timeout_s=self.cfg.aggregate_timeout_s + 1.0,
+            name="fetch")
+        for info in infos:
+            results.setdefault(info["worker_id"],
+                               {"error": "aggregation fetch timed out"})
+        return results
+
+    def _fetch_worker(self, info: Dict[str, Any], path: str):
+        if info["state"] != "healthy":
+            return {"error": f"worker is {info['state']}"}
+        try:
+            _, payload = request_json(
+                self.sup.host, info["port"], "GET", path,
+                timeout_s=self.cfg.aggregate_timeout_s)
+            return payload
+        except Exception as exc:  # noqa: BLE001 - aggregation best-effort
+            return {"error": str(exc)}
+
+    def metrics_text(self) -> str:
+        """The router's registry plus every healthy worker's exposition
+        with ``worker=`` labels injected into the ``di_*`` families —
+        merged per family so the combined scrape stays valid."""
+        families = _parse_exposition(expfmt.render())
+        for worker_id, text in self._fetch_workers("/metrics").items():
+            if not isinstance(text, str):
+                continue
+            for name, fam in _parse_exposition(
+                    text, relabel=worker_id).items():
+                mine = families.setdefault(
+                    name, {"help": fam["help"], "type": fam["type"],
+                           "samples": []})
+                mine["samples"].extend(fam["samples"])
+        out: List[str] = []
+        for name, fam in families.items():
+            if fam["help"] is not None:
+                out.append(f"# HELP {name} {fam['help']}")
+            if fam["type"] is not None:
+                out.append(f"# TYPE {name} {fam['type']}")
+            out.extend(fam["samples"])
+        return "\n".join(out) + "\n"
+
+    def final_contract(self) -> Dict[str, Any]:
+        """The ``fleet/v1`` machine-readable record: the router's final
+        stdout line (``cli/serve.py``) and the base of every
+        ``/admin/rollover`` response."""
+        sup = self.sup.stats()
+        states = sup["states"]
+        active = sum(n for state, n in states.items() if state != "retired")
+        with self._lock:
+            routed, failovers, rollovers = (
+                self._routed, self._failovers, self._rollovers)
+        return {
+            "schema": "fleet/v1",
+            "metric": "fleet_unplanned_worker_restarts",
+            "value": float(sup["restarts_total"]),
+            "unit": "restarts",
+            # Cumulative trips, not just currently-open: the shutdown
+            # drain retires open-circuit workers right before the final
+            # line prints, and a degraded run must not exit "ok".
+            "ok": (sup["circuit_open"] == 0
+                   and sup["circuit_tripped_total"] == 0),
+            "circuit_tripped": sup["circuit_tripped_total"],
+            "workers": active,
+            "healthy": states.get("healthy", 0),
+            "restarts": sup["restarts_total"],
+            "circuit_open": sup["circuit_open"],
+            "rollovers": rollovers,
+            "failovers": failovers,
+            "routed": routed,
+            "state_path": sup["state_path"],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text merging (per-worker relabeled aggregation)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(.+)$")
+
+
+def _inject_label(line: str, worker_id: str) -> str:
+    """``name{a="b"} 1`` -> ``name{worker="wN",a="b"} 1`` (and the
+    label-less form grows the braces). Non-matching lines pass
+    through untouched."""
+    m = _SAMPLE_RE.match(line)
+    if m is None:
+        return line
+    name, _, inner, value = m.groups()
+    label = f'worker="{worker_id}"'
+    inner = f"{label},{inner}" if inner else label
+    return f"{name}{{{inner}}} {value}"
+
+
+def _family_of(sample_name: str) -> str:
+    """Histogram series (_bucket/_sum/_count) group under their base
+    family for HELP/TYPE purposes."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            return sample_name[: -len(suffix)]
+    return sample_name
+
+
+def _parse_exposition(text: str,
+                      relabel: Optional[str] = None) -> Dict[str, Dict]:
+    """Exposition text -> ordered {family: {help, type, samples}}.
+    With ``relabel``, a ``worker`` label is injected into every sample
+    of a ``di_*`` family (the repo's own namespace; foreign families
+    pass through unlabeled)."""
+    families: Dict[str, Dict] = {}
+
+    def fam(name: str) -> Dict:
+        return families.setdefault(
+            name, {"help": None, "type": None, "samples": []})
+
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            fam(name)["help"] = help_text
+        elif line.startswith("# TYPE "):
+            name, _, type_text = line[len("# TYPE "):].partition(" ")
+            fam(name)["type"] = type_text
+        elif line.strip() and not line.startswith("#"):
+            m = _SAMPLE_RE.match(line)
+            name = _family_of(m.group(1)) if m else line.split()[0]
+            if relabel is not None and name.startswith("di_"):
+                line = _inject_label(line, relabel)
+            fam(name)["samples"].append(line)
+    return families

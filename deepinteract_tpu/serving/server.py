@@ -299,10 +299,22 @@ class ServingServer:
                     draining = server._draining.is_set()
                     status = ("draining" if draining
                               else "overloaded" if degraded else "ok")
+                    # Warm-replica fields (fleet routing/rollover): the
+                    # served weights' identity and the AOT compile-cache
+                    # inventory, so a router (serving/router.py) can
+                    # verify a replica is warm on the right weights
+                    # BEFORE switching traffic to it — the same labels
+                    # /stats reports as compiled_buckets, via a cheap
+                    # accessor (this route is probed every supervisor
+                    # tick).
                     self._send_json(200, {
                         "status": status,
                         "draining": draining,
                         "degraded": degraded,
+                        "weights_signature":
+                            server.engine.weights_signature(),
+                        "warm_buckets":
+                            server.engine.warm_bucket_labels(),
                     })
                 elif route == "/stats":
                     self._send_json(200, server.stats())

@@ -72,7 +72,7 @@ def test_registered_kinds_cover_every_contract_cli():
     whose final line is a machine contract has a registered kind, so a
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
-            "perf_regression", "lint", "fsck"} <= set(CONTRACTS)
+            "perf_regression", "lint", "fsck", "fleet"} <= set(CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
 
@@ -179,6 +179,27 @@ def test_fsck_kind_matches_real_cli_emission(tmp_path, capsys):
     assert rec["ok"] is False and rec["corrupt"] == 1
     assert rec["verified"] == 1
     assert rec["corrupt_paths"] == [str(bad)]
+
+
+def test_fleet_kind_matches_real_router_emission(tmp_path, capsys):
+    """The fleet/v1 contract is validated against the REAL fleet path:
+    cli.serve --workers over a stub worker, drained immediately — the
+    final stdout line must be the router's contract (and the same record
+    backs every /admin/rollover response, tests/test_fleet.py)."""
+    from deepinteract_tpu.cli.serve import main
+    from deepinteract_tpu.robustness.preemption import PreemptionGuard
+
+    guard = PreemptionGuard(log=lambda s: None)
+    guard.request("test drain")  # run() starts, then drains right away
+    rc = main(["--workers", "1", "--fleet_stub_workers", "--port", "0",
+               "--fleet_dir", str(tmp_path)], guard=guard)
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "fleet")
+    assert rec["schema"] == "fleet/v1"
+    # The final line prints AFTER the drain: every worker retired
+    # cleanly (workers = still-supervised count), nothing crashed.
+    assert rec["ok"] is True and rec["workers"] == 0
+    assert rec["restarts"] == 0 and rec["rollovers"] == 0
 
 
 def test_cli_main_entry(tmp_path, capsys):

@@ -129,6 +129,40 @@ def test_compare_tolerances_and_directions():
         r["key"] for r in verdict["regressions"]}
 
 
+def test_rollover_keys_gate_including_zero_baseline_drops():
+    """ISSUE-13 satellite: the bench `rollover` keys gate. The dropped-
+    request count has a LEGITIMATE baseline of zero, where relative
+    tolerance math is undefined — it gates as an absolute ceiling (any
+    drop regresses) instead of silently passing."""
+    base = dict(GOOD, rollover={"p99_during_rollover_ms": 40.0,
+                                "dropped_requests": 0})
+    # Same shape, no drops: clean.
+    verdict = compare(dict(base), base)
+    assert verdict["ok"]
+    assert {"rollover.p99_during_rollover_ms",
+            "rollover.dropped_requests"} <= set(verdict["compared"])
+    # A single dropped request during rollover is a regression even
+    # though the baseline is 0.
+    dropped = dict(GOOD, rollover={"p99_during_rollover_ms": 40.0,
+                                   "dropped_requests": 1})
+    verdict = compare(dropped, base)
+    (reg,) = verdict["regressions"]
+    assert reg["key"] == "rollover.dropped_requests"
+    assert "ceiling" in reg["detail"] and not verdict["ok"]
+    # The rollover tail blowing past its band regresses too.
+    slow = dict(GOOD, rollover={"p99_during_rollover_ms": 200.0,
+                                "dropped_requests": 0})
+    verdict = compare(slow, base)
+    assert {r["key"] for r in verdict["regressions"]} == {
+        "rollover.p99_during_rollover_ms"}
+    # Losing a rollover key entirely is the plumbing class.
+    lost = dict(GOOD, rollover={"p99_during_rollover_ms": 40.0})
+    verdict = compare(lost, base)
+    assert any(r["kind"] == "plumbing"
+               and r["key"] == "rollover.dropped_requests"
+               for r in verdict["regressions"])
+
+
 def test_missing_perf_key_is_a_plumbing_regression():
     """The generalized "parsed": null class: a key the baseline carried
     that the fresh contract lost fails loudly, never silently passes."""

@@ -808,6 +808,26 @@ def test_http_predict_and_stats_round_trip(server):
         conn.close()
 
 
+def test_healthz_reports_weights_signature_and_warm_buckets(server):
+    """ISSUE-13 satellite: /healthz carries the served weights' identity
+    and the AOT compile-cache inventory, so the fleet router
+    (serving/router.py) can verify a replica is warm on the right
+    weights BEFORE switching traffic to it — without a second /stats
+    round trip."""
+    srv, _, _, _ = server
+    host, port = srv.address
+    srv.engine.predict(fresh_raw(773))  # at least one warm executable
+    status, health = _get(host, port, "/healthz")
+    assert status == 200
+    assert health["weights_signature"] == srv.engine.weights_signature()
+    eng = srv.engine.stats()
+    assert health["warm_buckets"] == sorted(eng["compiled_buckets"])
+    assert len(health["warm_buckets"]) >= 1
+    # The rollover readiness check matches on the bucket-shape prefix.
+    assert any(label.startswith("64x64/") for label in
+               health["warm_buckets"])
+
+
 def test_metrics_exposition_parses_and_agrees_with_stats(server):
     """GET /metrics is valid Prometheus text (0.0.4) covering request
     count, the latency histogram, queue depth, compile-cache size, and

@@ -66,6 +66,16 @@ CONTRACTS = {
         "numeric": ("value", "files_scanned", "findings_total",
                     "findings_new", "findings_baselined", "suppressed"),
     },
+    # fleet/v1: the fleet router's final stdout line (cli/serve.py
+    # --workers N) and every POST /admin/rollover response
+    # (serving/router.py FleetRouter.final_contract).
+    "fleet": {
+        "required": ("schema", "metric", "value", "unit", "ok",
+                     "workers", "healthy", "restarts", "circuit_open",
+                     "rollovers", "failovers", "routed"),
+        "numeric": ("value", "workers", "healthy", "restarts",
+                    "circuit_open", "rollovers", "failovers", "routed"),
+    },
     # fsck/v1: python -m deepinteract_tpu.cli.fsck (durable-artifact
     # verify/quarantine/report; robustness/artifacts.py).
     "fsck": {

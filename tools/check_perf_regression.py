@@ -70,6 +70,23 @@ TOLERANCES = {
     "saturation.p99_ratio": (0.50, -1),
     "saturation.served_per_sec": (0.35, +1),
     "saturation.served_p99_ms": (0.50, -1),
+    # Zero-downtime rollover contract (bench `rollover` section,
+    # ISSUE-13): the tail during a live weights rollover must stay
+    # bounded, and dropped requests must stay at ZERO (see
+    # ZERO_BASELINE_CEILINGS — a 0 baseline still gates).
+    "rollover.p99_during_rollover_ms": (0.75, -1),
+    # The ISSUE-13 acceptance bar is the RATIO (p99 during rollover vs
+    # the same router's steady p99) — gate it directly, like
+    # saturation.p99_ratio, so a fleet-layer tail regression can't hide
+    # inside the absolute key's band when steady state shifted too.
+    "rollover.p99_ratio": (0.50, -1),
+    "rollover.dropped_requests": (0.0, -1),
+}
+# Lower-better keys whose baseline is legitimately 0 (e.g. dropped
+# requests): relative tolerance math is undefined at 0, so these gate as
+# an absolute ceiling — fresh must stay <= baseline + ceiling.
+ZERO_BASELINE_CEILINGS = {
+    "rollover.dropped_requests": 0.0,
 }
 # Keys whose values must match exactly for the runs to be comparable at
 # all (a different metric/unit is a different experiment, not a drift).
@@ -193,6 +210,14 @@ def compare(fresh: dict, baseline: dict) -> dict:
         new_val = float(flat_fresh[key])
         compared.append(key)
         if base_val == 0:
+            ceiling = ZERO_BASELINE_CEILINGS.get(key)
+            if ceiling is not None and new_val > ceiling:
+                regressions.append({
+                    "key": key, "kind": "perf", "baseline": base_val,
+                    "fresh": new_val, "tolerance": ceiling,
+                    "detail": ("zero-baseline key exceeded its absolute "
+                               f"ceiling ({ceiling})"),
+                })
             continue
         widened = warned and key in TIMING_WARNED_KEYS
         if widened:
