@@ -196,7 +196,51 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
                    help="train batches per epoch that may be skipped (and "
                         "logged) when a complex fails to load, instead of "
                         "killing the epoch; over budget still raises. "
-                        "Single-host only (0 = fail fast)")
+                        "Multi-host runs broadcast every drop decision "
+                        "from host 0 so all hosts skip identical batches "
+                        "(0 = fail fast)")
+    g.add_argument("--save_every_steps", type=int, default=0,
+                   help="intra-epoch checkpoint cadence: every N optimizer "
+                        "steps the state lands in the checkpoint's mid/ "
+                        "root with the exact loader cursor, so a crash or "
+                        "kill -9 mid-epoch re-pays at most N steps on "
+                        "--resume instead of the whole epoch (0 = epoch-"
+                        "boundary saves only)")
+
+    g = p.add_argument_group(
+        "self-healing supervision",
+        "run training as a supervised child (training/supervisor.py): "
+        "crashes restart with jittered backoff into --resume, a live-but-"
+        "hung child (stale heartbeat progress — a wedged collective) is "
+        "SIGKILLed and resumed, flappers trip a circuit breaker; the "
+        "final stdout line is the train_supervise/v1 contract")
+    g.add_argument("--supervise", action="store_true",
+                   help="supervisor mode: spawn this same command line as "
+                        "a child (with --heartbeat_seconds forced on), "
+                        "watch it, restart it into --resume on crash or "
+                        "hang")
+    g.add_argument("--watch_interval_s", type=float, default=1.0,
+                   help="supervisor poll cadence: process liveness + "
+                        "heartbeat freshness per tick")
+    g.add_argument("--hang_timeout_s", type=float, default=600.0,
+                   help="a live child whose heartbeat shows no step/eval/"
+                        "checkpoint progress for this long is wedged "
+                        "(stuck collective): SIGKILL + restart into "
+                        "--resume")
+    g.add_argument("--start_grace_s", type=float, default=900.0,
+                   help="per-(re)spawn grace before hang/no-heartbeat "
+                        "verdicts apply (covers import + restore + "
+                        "compile, which make no step progress)")
+    g.add_argument("--train_restart_backoff_s", type=float, default=1.0,
+                   help="base of the jittered exponential backoff between "
+                        "child restarts (capped at 60s)")
+    g.add_argument("--train_circuit_max_restarts", type=int, default=5,
+                   help="restarts inside --train_circuit_window_s after "
+                        "which the supervisor stops restarting (a "
+                        "poisoned run must not crash-loop forever) and "
+                        "exits nonzero with circuit_open in the contract")
+    g.add_argument("--train_circuit_window_s", type=float, default=3600.0,
+                   help="sliding window for --train_circuit_max_restarts")
 
     g = p.add_argument_group("input pipeline")
     g.add_argument("--device_prefetch", action="store_true",
@@ -515,6 +559,7 @@ def configs_from_args(
         preemption_guard=not getattr(args, "no_preemption_guard", False),
         span_log=not getattr(args, "no_span_log", False),
         heartbeat_seconds=getattr(args, "heartbeat_seconds", 0.0),
+        save_every_steps=getattr(args, "save_every_steps", 0),
         profile_dir=getattr(args, "profile_dir", None),
         profile_steps=getattr(args, "profile_steps", 3),
         device_prefetch=getattr(args, "device_prefetch", False),

@@ -35,8 +35,13 @@ from typing import Dict
 from deepinteract_tpu.robustness import artifacts
 
 # Sidecar-less files fsck still recognizes and JSON-parse-checks (the
-# legacy coverage edge).
-KNOWN_UNVERIFIED_BASENAMES = ("trainer_state.json", "tuning_store.json")
+# legacy coverage edge). Supervisor state files (training/supervisor.py,
+# serving/fleet.py) are atomic-but-sidecar-less by design: their value
+# is freshness, and a torn write is impossible (os.replace), so a parse
+# failure here means bit rot — flagged.
+KNOWN_UNVERIFIED_BASENAMES = ("trainer_state.json", "tuning_store.json",
+                              "train_supervisor_state.json",
+                              "fleet_state.json")
 
 # A heartbeat this old is reported stale (obs/heartbeat.read_heartbeat
 # does the math — shared with the fleet supervisor's liveness check).
@@ -54,10 +59,11 @@ _SKIP_DIR_NAMES = {"__pycache__"}
 
 def _is_step_dir(path: str) -> bool:
     """An orbax checkpoint step: an integer-named directory directly
-    under a ``best/`` or ``last/`` root."""
+    under a ``best/``, ``last/``, or ``mid/`` (intra-epoch cadence
+    saves, training/checkpoint.py) root."""
     name = os.path.basename(path)
     parent = os.path.basename(os.path.dirname(path))
-    return name.isdigit() and parent in ("best", "last")
+    return name.isdigit() and parent in ("best", "last", "mid")
 
 
 def _check_tree(path: str, report: Dict) -> None:
@@ -112,21 +118,74 @@ def _check_file(path: str, report: Dict, require_sidecar: bool = False) -> None:
 
 def _check_heartbeat(path: str, report: Dict) -> None:
     """Liveness classification through the ONE shared staleness check
-    (obs/heartbeat.read_heartbeat — the same helper the fleet supervisor
-    probes with), so fsck and supervision cannot disagree about "how old
-    is too old". Staleness is informational (the writer may simply have
-    finished), never a corruption — integrity is checked separately
-    above."""
+    (obs/heartbeat.read_heartbeat — the same helper the fleet AND
+    training supervisors probe with), so fsck and supervision cannot
+    disagree about "how old is too old". Staleness is informational (the
+    writer may simply have finished), never a corruption — integrity is
+    checked separately above. The writing host rides along (training
+    heartbeats are per-process files), so a pod operator sees WHICH host
+    went quiet straight from the contract line."""
     from deepinteract_tpu.obs.heartbeat import read_heartbeat
 
     status = read_heartbeat(path, HEARTBEAT_MAX_AGE_S)
+    host = None
+    if status.payload is not None:
+        host = status.payload.get("process_index",
+                                  status.payload.get("host"))
     report.setdefault("heartbeats", {})[path] = {
         "status": status.status,
         "age_s": (round(status.age_s, 1)
                   if status.age_s is not None else None),
+        "host": host,
     }
     if status.status == "stale":
         report["stale_heartbeats"] = report.get("stale_heartbeats", 0) + 1
+        report.setdefault("stale_heartbeat_hosts", []).append(
+            host if host is not None else os.path.basename(path))
+
+
+def _check_trainer_cursor(path: str, report: Dict) -> None:
+    """Validate the mid-epoch resume cursor (--save_every_steps,
+    training/loop.py) riding trainer_state.json: a structurally damaged
+    cursor would corrupt the next --resume's ledger, so it is flagged
+    (and quarantined) as corruption, not styled over. A healthy cursor
+    surfaces in the fsck/v1 contract so an operator sees where the run
+    would resume without opening the file."""
+    if any(e["path"] == path for e in report["corrupt_paths"]):
+        return  # integrity layer already flagged (and maybe moved) it
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return  # already flagged by the parse checks above
+    cur = payload.get("cursor") if isinstance(payload, dict) else None
+    if cur is None:
+        return
+    problems = []
+    if not isinstance(cur, dict):
+        problems.append("cursor is not an object")
+    else:
+        for key in ("epoch", "batch_index", "opt_step", "skips_used",
+                    "skipped_steps"):
+            v = cur.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"cursor.{key} is not a non-negative int")
+        ledger = cur.get("loss_ledger")
+        if (not isinstance(ledger, list)
+                or not all(isinstance(x, (int, float))
+                           and not isinstance(x, bool) for x in ledger)):
+            problems.append("cursor.loss_ledger is not a number list")
+        elif (isinstance(cur.get("batch_index"), int)
+                and len(ledger) > cur["batch_index"]):
+            problems.append("cursor.loss_ledger longer than batch_index")
+    if problems:
+        _mark_corrupt(path, "resume cursor malformed: "
+                      + "; ".join(problems), "trainer-state", report)
+        return
+    report["resume_cursor"] = {
+        "epoch": cur["epoch"], "batch_index": cur["batch_index"],
+        "opt_step": cur["opt_step"], "skips_used": cur["skips_used"],
+    }
 
 
 def _mark_corrupt(path: str, reason: str, kind: str, report: Dict) -> None:
@@ -177,6 +236,8 @@ def scan(root: str, do_quarantine: bool, do_sweep: bool) -> Dict:
             spill = name.startswith("emb_") and name.endswith(".npz")
             if has_sidecar or spill or _known_json_artifact(name):
                 _check_file(path, report, require_sidecar=spill)
+            if name == "trainer_state.json":
+                _check_trainer_cursor(path, report)
             if name.startswith("heartbeat") and name.endswith(".json"):
                 _check_heartbeat(path, report)
     if do_sweep or do_quarantine:
@@ -218,7 +279,9 @@ def main(argv=None) -> int:
 
     for path, hb in sorted(report.get("heartbeats", {}).items()):
         if hb["status"] == "stale":
-            print(f"stale heartbeat ({hb['age_s']}s old): {path}")
+            host = (f" host {hb['host']}" if hb.get("host") is not None
+                    else "")
+            print(f"stale heartbeat ({hb['age_s']}s old){host}: {path}")
     for path in report["unverified_paths"]:
         print(f"unverified (no integrity sidecar): {path}")
     for path in report["orphan_sidecars"]:
@@ -246,6 +309,8 @@ def main(argv=None) -> int:
         "recovered": recovered,
         "orphan_sidecars": len(report["orphan_sidecars"]),
         "stale_heartbeats": report.get("stale_heartbeats", 0),
+        "stale_heartbeat_hosts": report.get("stale_heartbeat_hosts", []),
+        "resume_cursor": report.get("resume_cursor"),
         "tmp_files": len(report["tmp_paths"]),
         "tmp_swept": report["tmp_swept"],
         "corrupt_paths": [e["path"] for e in report["corrupt_paths"][:20]],

@@ -9,6 +9,7 @@ with EarlyStopping + checkpointing -> fit -> final test pass.
 
 from __future__ import annotations
 
+import json
 import sys
 
 from deepinteract_tpu.cli.args import (
@@ -17,6 +18,48 @@ from deepinteract_tpu.cli.args import (
     make_mesh_from_args,
     make_metric_writer,
 )
+
+
+def _supervise_main(args, argv) -> int:
+    """--supervise: spawn this command line (supervisor flags stripped,
+    --heartbeat_seconds forced on) as a watched child; crashes and hangs
+    restart into --resume with backoff, flappers trip the circuit. The
+    final stdout line is the train_supervise/v1 contract."""
+    import os
+
+    from deepinteract_tpu.training.supervisor import (
+        SuperviseConfig,
+        TrainingSupervisor,
+        strip_supervisor_flags,
+        train_child_cmd_fn,
+    )
+
+    # The watched heartbeat is the one the Trainer writes for this host's
+    # process index (training/loop.py fit).
+    process_index = args.process_id or 0
+    heartbeat_path = os.path.join(
+        args.ckpt_dir, "obs", f"heartbeat_p{process_index}.json")
+    heartbeat_seconds = (args.heartbeat_seconds
+                         if args.heartbeat_seconds > 0 else 5.0)
+    supervisor = TrainingSupervisor(
+        train_child_cmd_fn(strip_supervisor_flags(argv), heartbeat_seconds),
+        SuperviseConfig(
+            heartbeat_path=heartbeat_path,
+            state_dir=args.ckpt_dir,
+            heartbeat_seconds=heartbeat_seconds,
+            poll_interval_s=args.watch_interval_s,
+            hang_timeout_s=args.hang_timeout_s,
+            start_grace_s=args.start_grace_s,
+            restart_backoff_s=args.train_restart_backoff_s,
+            circuit_max_restarts=args.train_circuit_max_restarts,
+            circuit_window_s=args.train_circuit_window_s,
+        ),
+        log=lambda s: print(s, flush=True))
+    rc = supervisor.run()
+    # The FINAL stdout line is the machine contract (tools/
+    # check_cli_contract.py kind ``train_supervise``).
+    print(json.dumps(supervisor.contract()), flush=True)
+    return rc
 
 
 def main(argv=None) -> int:
@@ -29,6 +72,15 @@ def main(argv=None) -> int:
     g.add_argument("--num_processes", type=int, default=None)
     g.add_argument("--process_id", type=int, default=None)
     args = parser.parse_args(argv)
+
+    if args.supervise:
+        # Supervisor mode (training/supervisor.py): run this same command
+        # line as a watched child — BEFORE initialize_distributed, so the
+        # parent stays a plain control plane and the child owns the
+        # coordination service (a restarted rank-0 child rebinds the
+        # coordinator port only because the parent never held it).
+        return _supervise_main(args, list(sys.argv[1:] if argv is None
+                                          else argv))
 
     # Must run before anything touches the XLA backend (parallel/multihost
     # .py docstring); on TPU pods everything auto-detects, on CPU/GPU the
@@ -124,16 +176,17 @@ def main(argv=None) -> int:
             PackedDataset(_os.path.join(args.packed_cache_dir, split))
             for split, *_ in specs)
     if args.data_skip_budget and shard:
-        # A host-local batch skip would desync step counts across hosts
-        # and deadlock the collectives; the loader enforces the same rule.
-        print("multi-host run: --data_skip_budget disabled (skips must "
-              "agree across hosts)")
+        # Drop decisions are host-0-broadcast through the coordination
+        # KV store (data/loader.py _skip_agreement): every host skips
+        # identical batches, so step counts stay aligned by construction.
+        print("multi-host run: --data_skip_budget drop decisions are "
+              "host-0-coordinated (all hosts skip identical batches)")
     train_loader = BucketedLoader(
         train_ds, batch_size=args.batch_size, shuffle=True, drop_remainder=True,
         seed=args.seed, pad_to_max_bucket=args.pad_to_max_bucket, shard=shard,
         dispatch_run=max(1, args.steps_per_dispatch),
         diagonal_buckets=args.diagonal_buckets,
-        skip_budget=0 if shard else args.data_skip_budget,
+        skip_budget=args.data_skip_budget,
     )
     if shard:
         print(f"host {shard[0]}/{shard[1]}: {train_loader.num_batches()} "

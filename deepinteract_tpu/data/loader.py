@@ -131,14 +131,22 @@ class BucketedLoader:
         # be loud). The whole batch is dropped, not just the item — a
         # shrunken batch would change shapes and break bucketed compile
         # reuse. 0 disables (fail-fast, the previous behavior).
-        if skip_budget and shard is not None:
-            # A host-local skip would desynchronize step counts across
-            # hosts and deadlock the global collectives mid-epoch.
-            raise ValueError(
-                "skip_budget requires unsharded loading (multi-host "
-                "training cannot skip batches on one host only)"
-            )
+        # Multi-host (shard set, real multi-process runtime): every drop
+        # decision is host-0-broadcast through the coordination KV store
+        # (parallel/multihost.agree_any_flag), so ALL hosts skip
+        # identical batches — a host-local skip would desynchronize step
+        # counts and deadlock the global collectives mid-epoch.
         self.skip_budget = max(0, skip_budget)
+        # Cursor ledger (--save_every_steps resume): cumulative skips
+        # recorded at yield time, keyed by consumed-batch ordinal. Written
+        # on the prefetch thread, read for settled ordinals only.
+        self._skips_at: Dict[int, int] = {}
+        # Per-produce serial for the multi-host agreement keys: the
+        # coordination KV store is write-once per key, and the same epoch
+        # is legitimately produced more than once (cli.train's example
+        # fetch, then the real epoch) — hosts call _produce in the same
+        # order, so the serial stays aligned across the mesh.
+        self._agree_serial = 0
         # Optional h2d hook (--device_prefetch): a callable applied to each
         # assembled batch ON THE PREFETCH THREAD (``_produce`` runs inside
         # ``_prefetched``'s worker when prefetch > 0). The Trainer installs
@@ -231,11 +239,69 @@ class BucketedLoader:
         start = self.shard[0] * self.batch_size
         return chunk[start : start + self.batch_size]
 
-    def _produce(self, epoch: int, with_targets: bool) -> Iterator:
+    def _skip_agreement(self):
+        """Multi-host drop coordination: None for a lone process (local
+        decisions), else a callable returning the host-0-broadcast
+        verdict "any host failed to load this plan entry" (parallel/
+        multihost.agree_any_flag — host-side KV, prefetch-thread-safe).
+        Only armed alongside a skip budget: with budget 0 a failure
+        raises everywhere anyway, so batches never desync."""
+        if self.shard is None or self.skip_budget <= 0:
+            return None
+        import jax
+
+        from deepinteract_tpu.parallel import multihost
+
+        if jax.process_count() <= 1:
+            return None  # simulated shard in a single process (tests)
+        if not multihost.can_agree():
+            # A REAL mesh without the coordination client must fail loud:
+            # host-local drop decisions would silently desync step counts
+            # and deadlock the next collective — exactly the failure mode
+            # the coordinated protocol exists to prevent.
+            raise RuntimeError(
+                "multi-host skip_budget needs the jax coordination "
+                "client (jax.distributed.initialize ran, and this jax "
+                "version exposes distributed.global_state.client); set "
+                "skip_budget=0 or fix the runtime instead of risking a "
+                "cross-host batch desync")
+        self._agree_serial += 1
+        serial = self._agree_serial
+
+        def agree(epoch: int, plan_pos: int, local_fail: bool) -> bool:
+            return multihost.agree_any_flag(
+                f"di_loader_skip/{self.seed}/{serial}/{epoch}/{plan_pos}",
+                local_fail)
+
+        return agree
+
+    def skips_before(self, batches_consumed: int) -> int:
+        """Cumulative skip-budget drops before the given consumed-batch
+        ordinal of the current epoch — the Trainer's resume-cursor
+        ledger (training/loop.py midsave)."""
+        if batches_consumed <= 0:
+            return 0
+        return int(self._skips_at.get(int(batches_consumed), 0))
+
+    def _produce(self, epoch: int, with_targets: bool,
+                 start_batch: int = 0, skips_used: int = 0) -> Iterator:
         padded_batch = getattr(self.dataset, "padded_batch", None)
-        skips_left = self.skip_budget
-        for (b1, b2), chunk in self._epoch_plan(epoch):
+        skips_left = max(0, self.skip_budget - max(0, skips_used))
+        # Mid-epoch resume cursor: the first start_batch + skips_used
+        # plan entries were already paid (yielded or dropped) before the
+        # checkpoint — skip them WITHOUT loading (the plan is
+        # deterministic per (seed, epoch), so position alone suffices).
+        already_paid = max(0, start_batch) + max(0, skips_used)
+        produced = max(0, start_batch)
+        cum_skips = max(0, skips_used)
+        self._skips_at = {}
+        agree = self._skip_agreement()
+        for plan_pos, ((b1, b2), chunk) in enumerate(self._epoch_plan(epoch)):
+            if plan_pos < already_paid:
+                continue
             chunk = self._host_slice(chunk)
+            batch = targets = None
+            local_exc: Optional[Exception] = None
             try:
                 faults.maybe_raise(
                     "loader.batch",
@@ -259,16 +325,39 @@ class BucketedLoader:
                         targets.append(raw.get("target", str(idx)))
                     batch = stack_complexes(complexes)
             except Exception as exc:
-                if skips_left <= 0:
+                if skips_left <= 0 and agree is None:
                     raise
+                local_exc = exc
+            # The drop decision: local failure alone (single host), or
+            # the host-0-broadcast any-host-failed verdict — so a mesh
+            # skips IDENTICAL batches and step counts stay aligned.
+            drop = (agree(epoch, plan_pos, local_exc is not None)
+                    if agree is not None else local_exc is not None)
+            if drop:
+                if skips_left <= 0:
+                    if local_exc is not None:
+                        raise local_exc
+                    raise RuntimeError(
+                        f"a peer host failed to load batch (bucket "
+                        f"{b1}x{b2}, plan entry {plan_pos}) with the "
+                        "skip budget exhausted")
                 skips_left -= 1
+                cum_skips += 1
                 _SKIPPED.inc()
                 logger.warning(
                     "skipping corrupt batch (bucket %sx%s, items %s): %s "
                     "— %d skip(s) left this epoch",
-                    b1, b2, chunk, exc, skips_left,
+                    b1, b2, chunk,
+                    local_exc if local_exc is not None
+                    else "peer-host load failure (coordinated drop)",
+                    skips_left,
                 )
                 continue
+            if local_exc is not None:
+                # Defensive: agree() said keep but this host failed —
+                # unreachable under the any-host-failed OR, but a wrong
+                # verdict must raise loudly, never yield a None batch.
+                raise local_exc
             _BATCHES.inc()
             if self.device_transfer is not None:
                 # jax.device_put is async: issuing it here starts the h2d
@@ -276,13 +365,19 @@ class BucketedLoader:
                 # busy with the previous dispatch.
                 batch = self.device_transfer(batch)
                 _DEVICE_PREFETCHED.inc()
+            produced += 1
+            self._skips_at[produced] = cum_skips
             yield (batch, targets) if with_targets else batch
 
-    def iter_epoch(self, epoch: int = 0, with_targets: bool = False) -> Iterator:
+    def iter_epoch(self, epoch: int = 0, with_targets: bool = False,
+                   start_batch: int = 0, skips_used: int = 0) -> Iterator:
         if self.prefetch <= 0:
-            yield from self._produce(epoch, with_targets)
+            yield from self._produce(epoch, with_targets,
+                                     start_batch, skips_used)
             return
-        yield from _prefetched(self._produce(epoch, with_targets), self.prefetch)
+        yield from _prefetched(
+            self._produce(epoch, with_targets, start_batch, skips_used),
+            self.prefetch)
 
     def targets(self) -> List[str]:
         """Target names in epoch-0 iteration order (for eval CSV export)."""

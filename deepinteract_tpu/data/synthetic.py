@@ -116,3 +116,49 @@ def random_complex(
         example_mask=example_mask,
         contact_map=contact_map,
     )
+
+
+def write_tiny_npz_dataset(root: str, n_complexes: int = 5,
+                           n1: int = 24, n2: int = 21, seed: int = 0,
+                           knn: int = 6, geo_nbrhd_size: int = 2) -> None:
+    """Materialize a tiny on-disk DIPS-style dataset (processed/ npz tree
+    + split files) that ``cli.train --dips_root`` consumes directly.
+
+    The ONE builder the multi-host integration tests, the supervised
+    self-healing chaos tests, and bench's ``recovery`` section share —
+    same shapes, same seed discipline, so their subprocess train runs
+    stay deterministic and mutually comparable. All ``n_complexes``
+    complexes land in the train split; val/test reuse the first one."""
+    import os
+
+    from deepinteract_tpu.data.io import save_complex_npz
+
+    processed = os.path.join(root, "processed")
+    os.makedirs(processed, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    names = []
+    for i in range(n_complexes):
+        raws = []
+        cas = []
+        for n, origin in ((n1, np.zeros(3)),
+                          (n2, np.array([10.0, 0.0, 0.0]))):
+            bb = random_backbone(n, rng, origin=origin)
+            raws.append(F.featurize_chain(
+                bb, random_residue_feats(n, rng), knn=knn,
+                geo_nbrhd_size=geo_nbrhd_size, rng=rng))
+            cas.append(bb[:, 1, :])
+        d = np.linalg.norm(cas[0][:, None] - cas[1][None, :], axis=-1)
+        contact = (d < 8.0).astype(np.int32)
+        ii, jj = np.meshgrid(np.arange(n1), np.arange(n2), indexing="ij")
+        examples = np.stack([ii.ravel(), jj.ravel(), contact.ravel()],
+                            axis=1).astype(np.int32)
+        name = f"c{i}.npz"
+        save_complex_npz(os.path.join(processed, name), raws[0], raws[1],
+                         examples, complex_name=f"c{i}")
+        names.append(name)
+    for mode, sel in (("train", names), ("val", names[:1]),
+                      ("test", names[:1])):
+        # di: allow[artifact-write] regenerable synthetic split fixture
+        with open(os.path.join(root, f"pairs-postprocessed-{mode}.txt"),
+                  "w") as f:
+            f.write("\n".join(sel) + "\n")

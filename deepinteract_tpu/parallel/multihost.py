@@ -144,6 +144,60 @@ def assert_same_across_hosts(values, fail_message: str) -> None:
     )
 
 
+def _coordination_client():
+    """The jax distributed coordination-service client (host-side KV
+    store + barriers), or None when the runtime is uninitialized or the
+    jax version moved the handle."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+
+
+def can_agree() -> bool:
+    """True when :func:`agree_any_flag` has a working transport: a real
+    multi-process runtime with a live coordination client."""
+    return jax.process_count() > 1 and _coordination_client() is not None
+
+
+def agree_any_flag(tag: str, local_flag: bool,
+                   timeout_s: float = 120.0) -> bool:
+    """Host-0-decides OR over one boolean per host.
+
+    The transport is the coordination-service KV store — host-side RPC,
+    no device collective — so it is safe from a loader prefetch thread
+    while the main thread is mid-train-step collective (a device
+    collective issued there could interleave against the step's and
+    deadlock the mesh). Every host publishes its flag under ``tag``;
+    host 0 reads all of them, publishes the OR as the verdict, and every
+    host returns that same verdict. ``tag`` must be unique per decision
+    (the KV store is append-only for a run). Single-process: the local
+    flag IS the verdict."""
+    if jax.process_count() <= 1:
+        return bool(local_flag)
+    client = _coordination_client()
+    if client is None:
+        raise RuntimeError(
+            "agree_any_flag needs the jax coordination client "
+            "(jax.distributed.initialize ran?) — refusing to guess a "
+            "cross-host decision")
+    timeout_ms = max(1, int(timeout_s * 1000))
+    client.key_value_set(f"{tag}/h{jax.process_index()}",
+                         "1" if local_flag else "0")
+    if jax.process_index() == 0:
+        verdict = bool(local_flag)
+        for peer in range(1, jax.process_count()):
+            peer_flag = client.blocking_key_value_get(
+                f"{tag}/h{peer}", timeout_ms)
+            verdict = verdict or peer_flag == "1"
+        client.key_value_set(f"{tag}/verdict", "1" if verdict else "0")
+        return verdict
+    return client.blocking_key_value_get(f"{tag}/verdict",
+                                         timeout_ms) == "1"
+
+
 def is_primary_host() -> bool:
     """True on the process that should write checkpoints/logs (rank-0
     semantics of the reference's Lightning callbacks)."""

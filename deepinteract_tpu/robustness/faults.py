@@ -49,6 +49,12 @@ Registered sites:
 * ``fleet.kill``          — raises OSError when the supervisor delivers
   a signal to a worker (a drain's SIGTERM fails; the SIGKILL fallback
   must still retire the worker)
+* ``training.step_crash`` — raises RuntimeError at that train batch
+  (hard process crash with a traceback — the training supervisor's
+  restart-into---resume path, training/supervisor.py)
+* ``training.hang``       — freezes the step loop forever at that train
+  batch while the heartbeat thread keeps beating (the wedged-collective
+  simulation; only the supervisor watchdog's SIGKILL ends it)
 
 When no plan is configured every probe is a dict lookup on an empty map —
 effectively free on hot paths.

@@ -6,6 +6,14 @@ the reference (``lit_model_train.py:139-151``): monitor a chosen metric
 keep the top ``save_top_k`` checkpoints plus always the latest
 (``save_top_k=3, save_last=True``, ``lit_model_train.py:144-151``).
 
+A third root, ``mid/``, holds the newest **intra-epoch** cadence save
+(``--save_every_steps``, training/loop.py): one step whose number encodes
+the exact resume position (``epoch * MIDEPOCH_STRIDE + batch_index``),
+so a kill -9 mid-epoch re-pays at most one save cadence of steps instead
+of the whole epoch. ``restore(which='mid')`` is the resume entry point:
+it merges all three roots by decoded position and walks back through the
+PR-12 verification/quarantine discipline like any other restore.
+
 Durability (robustness/artifacts.py): every retained step directory gets
 a tree integrity sidecar (``<step>.integrity.json``, per-file SHA-256)
 written at :meth:`Checkpointer.wait`, and :meth:`Checkpointer.restore`
@@ -51,6 +59,30 @@ CHECKPOINT_KIND = artifacts.CHECKPOINT_KIND
 # positive evidence of a torn save (kill -9 mid-commit).
 _ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
 
+# Mid-epoch checkpoint step encoding (the ``mid/`` root only): the orbax
+# step number IS the resume position — ``epoch * STRIDE + batch_index``
+# — so a ``--resume`` after kill -9 recovers the exact next batch from
+# the step name alone, with no sidecar round trip that a crash between
+# the orbax save and the sidecar write could tear. ``best/``/``last/``
+# keep their historical epoch-boundary numbering (step = resume epoch).
+MIDEPOCH_STRIDE = 10 ** 8
+
+
+def encode_midepoch_step(epoch: int, batch_index: int) -> int:
+    if not 0 <= batch_index < MIDEPOCH_STRIDE:
+        raise ValueError(f"batch_index {batch_index} outside "
+                         f"[0, {MIDEPOCH_STRIDE})")
+    return int(epoch) * MIDEPOCH_STRIDE + int(batch_index)
+
+
+def decode_position(which: Optional[str], step: int) -> Tuple[int, int]:
+    """Orbax step -> (resume_epoch, resume_batch). ``mid/`` steps carry
+    both; ``best/``/``last/`` steps are epoch boundaries (the step IS
+    the epoch to resume at, batch 0)."""
+    if which == "mid":
+        return int(step) // MIDEPOCH_STRIDE, int(step) % MIDEPOCH_STRIDE
+    return int(step), 0
+
 
 def _partial_restore_args(target: Any):
     """Restore-args for a target tree that holds a SUBSET of the saved
@@ -80,6 +112,10 @@ class CheckpointConfig:
     metric_to_track: str = "val_ce"
     save_top_k: int = 3
     keep_last: bool = True
+    # mid/ root for intra-epoch cadence saves (training/loop.py
+    # --save_every_steps). Rides with keep_last: a run that keeps no
+    # last/ has nothing to resume into either way.
+    keep_midepoch: bool = True
 
 
 class Checkpointer:
@@ -115,6 +151,7 @@ class Checkpointer:
 
         mp_kwargs = {}
         root = os.path.abspath(cfg.directory)
+        keep_mid = cfg.keep_last and cfg.keep_midepoch
         if jax.process_count() > 1:
             mp_kwargs["multiprocessing_options"] = ocp.options.MultiprocessingOptions(
                 primary_host=jax.process_index(),
@@ -123,7 +160,9 @@ class Checkpointer:
             # orbax refuses create=True under active_processes; make the
             # roots ourselves (this manager is single-process by design).
             mp_kwargs["create"] = False
-            for sub in ("best", "last") if cfg.keep_last else ("best",):
+            subs = ["best"] + (["last"] if cfg.keep_last else [])
+            subs += ["mid"] if keep_mid else []
+            for sub in subs:
                 os.makedirs(os.path.join(root, sub), exist_ok=True)
         self.best = ocp.CheckpointManager(
             os.path.join(root, "best"),
@@ -140,6 +179,16 @@ class Checkpointer:
             if cfg.keep_last
             else None
         )
+        # Intra-epoch cadence saves (mid/): the newest resume position,
+        # step-number-encoded as epoch*STRIDE+batch (module docstring).
+        self.mid = (
+            ocp.CheckpointManager(
+                os.path.join(root, "mid"),
+                options=ocp.CheckpointManagerOptions(max_to_keep=1, **mp_kwargs),
+            )
+            if keep_mid
+            else None
+        )
         # Startup sweep: orphaned sidecar tmps from a killed run. The
         # orbax payloads themselves commit via directory rename, so only
         # OUR ``*.integrity.json.<pid>.tmp`` strays can linger here —
@@ -147,7 +196,8 @@ class Checkpointer:
         # store and trainer_state.json live here), so an unscoped sweep
         # could reap a concurrent cli.tune's live tmp.
         artifacts.sweep_tmp(root, prefix="trainer_state.json")
-        for d in (os.path.join(root, "best"), os.path.join(root, "last")):
+        for d in (os.path.join(root, "best"), os.path.join(root, "last"),
+                  os.path.join(root, "mid")):
             artifacts.sweep_tmp(d, contains=artifacts.SIDECAR_SUFFIX + ".")
 
     def save(self, step: int, state: Any, metrics: dict) -> None:
@@ -160,10 +210,23 @@ class Checkpointer:
         if self.last is not None:
             self.last.save(step, args=ocp.args.StandardSave(state))
 
+    def save_midepoch(self, epoch: int, batch_index: int, state: Any) -> None:
+        """Intra-epoch cadence save (``--save_every_steps``): mid/ only —
+        no metric exists mid-epoch, so best/ bookkeeping is untouched, and
+        last/ keeps its epoch-boundary meaning. The step number encodes
+        the exact resume position."""
+        if self.mid is None:
+            raise RuntimeError("mid-epoch saves need keep_last + "
+                               "keep_midepoch (CheckpointConfig)")
+        self.mid.save(encode_midepoch_step(epoch, batch_index),
+                      args=ocp.args.StandardSave(state))
+
     def wait(self) -> None:
         self.best.wait_until_finished()
         if self.last is not None:
             self.last.wait_until_finished()
+        if self.mid is not None:
+            self.mid.wait_until_finished()
         self._finalize_integrity()
 
     # -- integrity ---------------------------------------------------------
@@ -172,6 +235,8 @@ class Checkpointer:
         out: List[Tuple[Any, str]] = [(self.best, "best")]
         if self.last is not None:
             out.append((self.last, "last"))
+        if self.mid is not None:
+            out.append((self.mid, "mid"))
         return out
 
     @staticmethod
@@ -254,11 +319,33 @@ class Checkpointer:
             return self.last.latest_step()
         return self.best.latest_step()
 
+    def has_restorable(self) -> bool:
+        """Any retained step across mid/last/best (the --resume presence
+        probe; latest_step() keeps its historical boundary-roots-only
+        meaning for the callers that interpret steps as epochs)."""
+        if self.mid is not None and self._steps(self.mid):
+            return True
+        return self.latest_step() is not None
+
     def _restore_candidates(self, which: str) -> List[Tuple[Any, str, int]]:
         """(manager, name, step) in walk-back preference order: the
         requested root newest-first, then the sibling root newest-first —
-        except that ``which='best'`` leads with the metric-best step."""
+        except that ``which='best'`` leads with the metric-best step, and
+        ``which='mid'`` (the resume entry) merges all three roots by
+        DECODED resume position, newest position first (a mid-epoch save
+        outranks its own epoch's boundary, the next boundary outranks it;
+        within a tie last/ is preferred over best/)."""
         out: List[Tuple[Any, str, int]] = []
+        if which == "mid":
+            rank = {"mid": 2, "last": 1, "best": 0}
+            cands = [
+                (mgr, name, s)
+                for mgr, name in self._managers()
+                for s in self._steps(mgr)
+            ]
+            cands.sort(key=lambda t: (decode_position(t[1], t[2]),
+                                      rank[t[1]]), reverse=True)
+            return cands
         if which == "last" and self.last is not None:
             for s in sorted(self._steps(self.last), reverse=True):
                 out.append((self.last, "last", s))
@@ -303,7 +390,11 @@ class Checkpointer:
         host that owns this Checkpointer (host 0 in multi-host runs) and
         reaches the others via the resume broadcast in training/loop.py.
         """
-        mgr = self.best if which == "best" or self.last is None else self.last
+        if which == "mid" and self.mid is not None:
+            mgr = self.mid
+        else:
+            mgr = (self.best if which == "best" or self.last is None
+                   else self.last)
         if step is not None:
             step_dir = os.path.join(str(mgr.directory), str(step))
             try:
@@ -372,3 +463,5 @@ class Checkpointer:
         self.best.close()
         if self.last is not None:
             self.last.close()
+        if self.mid is not None:
+            self.mid.close()

@@ -50,7 +50,12 @@ from deepinteract_tpu.robustness.guards import (
 )
 from deepinteract_tpu.robustness.preemption import PreemptionGuard, TrainingPreempted
 from deepinteract_tpu.training import metrics as M
-from deepinteract_tpu.training.checkpoint import Checkpointer, CheckpointConfig, metric_mode
+from deepinteract_tpu.training.checkpoint import (
+    Checkpointer,
+    CheckpointConfig,
+    decode_position,
+    metric_mode,
+)
 from deepinteract_tpu.training.optim import OptimConfig
 from deepinteract_tpu.training.steps import TrainState, create_train_state, eval_step, train_step
 
@@ -124,6 +129,16 @@ class LoopConfig:
     # Where non-finite abort diagnostics are written (None: ckpt_dir,
     # falling back to the working directory).
     diagnostics_dir: Optional[str] = None
+    # Intra-epoch checkpoint cadence (0 disables): every N optimizer
+    # steps the state is saved to the checkpoint's mid/ root with the
+    # exact resume position encoded in the step number, and the loader
+    # cursor (loss ledger, skip-budget ledger) rides the
+    # trainer_state.json sidecar — so a crash / kill -9 / watchdog
+    # SIGKILL mid-epoch re-pays at most N steps on --resume instead of
+    # the whole epoch (exact-parity-tested). Saves are synchronous and
+    # happen at dispatch boundaries; multi-host runs save on host 0 and
+    # broadcast the resume decision like every other checkpoint read.
+    save_every_steps: int = 0
     # Overlap the per-epoch checkpoint save with the next epoch's
     # training: the state is snapshotted on-device (one HBM copy, safe
     # under donated mesh steps) and a single worker thread fetches + runs
@@ -522,6 +537,11 @@ class Trainer:
         k = max(1, self.cfg.eval_batches_per_dispatch)
         for run in _shape_runs(_iter_data(val_data, 0), k):
             self._check_preempt()
+            if self._heartbeat is not None:
+                # Eval dispatches are forward progress too: without this
+                # tick a long val epoch would read as a hung step loop to
+                # the supervisor watchdog (training/supervisor.py).
+                self._heartbeat.progress(phase=f"eval:{stage}")
             if run:
                 check_host_agreement(run[0])
             if len(run) < max(k, 2):
@@ -581,18 +601,30 @@ class Trainer:
             metric_mode(cfg.metric_to_track), cfg.patience, cfg.min_delta
         )
         start_epoch = 0
+        # Mid-epoch resume cursor (--save_every_steps): the position comes
+        # from the restored step number alone (training/checkpoint.py
+        # decode_position — crash-window-free); the sidecar cursor merely
+        # enriches it with the partial epoch's loss ledger and the loader
+        # skip-budget ledger so the resumed epoch's logged metrics match
+        # the uninterrupted run exactly.
+        start_batch = 0
+        resume_skips = 0
+        resume_skipped_steps = 0
+        resume_losses: List[float] = []
         if resume:
-            if ckpt is not None and ckpt.latest_step() is not None:
+            if ckpt is not None and ckpt.has_restorable():
                 state = _restore_into(
-                    state, ckpt.restore(state_template(state), which="last"))
+                    state, ckpt.restore(state_template(state), which="mid"))
                 # The step the restore ACTUALLY loaded: the last-good
                 # fallback (training/checkpoint.py) may have quarantined
                 # a corrupt newest step and walked back, and the epoch
                 # counter must follow the restored state, not the
                 # pre-quarantine directory listing.
                 restored_step = ckpt.last_restored_step
-                start_epoch = int(restored_step if restored_step is not None
-                                  else ckpt.latest_step())
+                start_epoch, start_batch = decode_position(
+                    ckpt.last_restored_which,
+                    int(restored_step if restored_step is not None
+                        else ckpt.latest_step()))
                 # EarlyStopping bookkeeping rides a JSON sidecar next to
                 # the orbax roots: a preemption-resume must not reset
                 # patience/best, or the resumed run would stop later than
@@ -603,24 +635,63 @@ class Trainer:
                 if sidecar and int(sidecar.get("epoch", -1)) == start_epoch:
                     stopper.best = float(sidecar["stopper_best"])
                     stopper.stale_epochs = int(sidecar["stopper_stale"])
-                self.log(f"resumed from epoch {start_epoch}")
+                if start_batch:
+                    cur = (sidecar or {}).get("cursor") or {}
+                    if (int(cur.get("epoch", -1)) == start_epoch
+                            and int(cur.get("batch_index", -1))
+                            == start_batch):
+                        resume_losses = [float(x)
+                                         for x in cur.get("loss_ledger", [])]
+                        resume_skips = int(cur.get("skips_used", 0))
+                        resume_skipped_steps = int(
+                            cur.get("skipped_steps", 0))
+                    else:
+                        self.log(
+                            "mid-epoch resume: trainer_state.json cursor "
+                            "does not match the restored checkpoint "
+                            "(killed between save and sidecar write?); "
+                            "position is exact but the interrupted "
+                            "epoch's logged train_loss covers only the "
+                            "re-run batches")
+                    self.log(f"resumed from epoch {start_epoch}, "
+                             f"batch {start_batch}")
+                else:
+                    self.log(f"resumed from epoch {start_epoch}")
             if jax.process_count() > 1:
                 # Only the primary host holds the Checkpointer; every other
-                # host must receive the restored state, epoch, and stopper
-                # bookkeeping, or the hosts would train different weights
-                # over different epoch ranges / disagree on the early-stop
-                # epoch (split-brain + collective deadlock at the end).
-                # The scalars go first on their own: a fresh start (no
-                # checkpoint) then skips broadcasting the full state tree.
+                # host must receive the restored state, epoch/batch cursor,
+                # and stopper bookkeeping, or the hosts would train
+                # different weights over different epoch ranges / disagree
+                # on the early-stop epoch (split-brain + collective
+                # deadlock at the end). The scalars go first on their own:
+                # a fresh start (no checkpoint) then skips broadcasting the
+                # full state tree.
                 from jax.experimental import multihost_utils
 
                 vec = multihost_utils.broadcast_one_to_all(np.asarray(
                     [float(start_epoch), stopper.best,
-                     float(stopper.stale_epochs)], dtype=np.float64))
+                     float(stopper.stale_epochs), float(start_batch),
+                     float(resume_skips), float(resume_skipped_steps),
+                     float(len(resume_losses))], dtype=np.float64))
                 start_epoch = int(vec[0])
                 stopper.best = float(vec[1])
                 stopper.stale_epochs = int(vec[2])
-                if start_epoch > 0:
+                start_batch = int(vec[3])
+                resume_skips = int(vec[4])
+                resume_skipped_steps = int(vec[5])
+                n_ledger = int(vec[6])
+                if n_ledger:
+                    # The partial epoch's loss ledger (variable length, so
+                    # it cannot ride the fixed vec): non-primary hosts
+                    # contribute a same-shape placeholder and adopt host
+                    # 0's values — their epoch line must match its.
+                    ledger = np.zeros((n_ledger,), dtype=np.float64)
+                    if resume_losses:
+                        ledger[:] = np.asarray(resume_losses,
+                                               dtype=np.float64)
+                    ledger = multihost_utils.broadcast_one_to_all(ledger)
+                    resume_losses = [float(x) for x in ledger]
+                if start_epoch > 0 or start_batch > 0:
                     tree = multihost_utils.broadcast_one_to_all(
                         state_to_tree(state))
                     state = _restore_into(
@@ -697,6 +768,15 @@ class Trainer:
                 lambda tr=tree, sn=step_no, me=dict(metrics):
                     ckpt.save(sn, _fetch_tree(tr), me))
 
+        def _drain_pending() -> None:
+            # Mid-epoch saves and the async boundary saver share the orbax
+            # managers; the in-flight boundary save must land first (two
+            # concurrent saves on one manager race its retention pass).
+            nonlocal pending
+            if pending is not None:
+                pending.result()
+                pending = None
+
         # Telemetry plumbing (obs/): span JSONL under the run dir, plus the
         # optional liveness heartbeat. Both are host-side only, and both
         # start HERE — immediately before the try/finally that tears them
@@ -741,10 +821,27 @@ class Trainer:
             epoch_span = obs_spans.span("epoch", epoch=epoch)
             epoch_span.__enter__()
             t_epoch = time.time()
-            train_losses = []
+            # Resuming mid-epoch: the interrupted epoch re-enters with the
+            # cursor — already-paid batches' losses prefill the ledger so
+            # the epoch line matches the uninterrupted run, and the loader
+            # restarts at the exact next batch.
+            resuming_here = epoch == start_epoch and start_batch > 0
+            train_losses = list(resume_losses) if resuming_here else []
             epoch_stats: Dict[str, float] = {}
-            state = self._run_train_epoch(state, train_data, epoch,
-                                          train_losses, epoch_stats)
+            if resuming_here:
+                epoch_stats["skipped_steps"] = resume_skipped_steps
+            midsave = None
+            if ckpt is not None and cfg.save_every_steps > 0:
+                midsave = self._make_midsave(
+                    ckpt, epoch, stopper, train_losses, epoch_stats,
+                    train_data,
+                    base_skips=resume_skips if resuming_here else 0,
+                    drain_pending=lambda: _drain_pending())
+            state = self._run_train_epoch(
+                state, train_data, epoch, train_losses, epoch_stats,
+                start_batch=start_batch if resuming_here else 0,
+                skips_used=resume_skips if resuming_here else 0,
+                save_fn=midsave)
             t_train_done = time.time()
             if cfg.nonfinite_guard:
                 # Guarded epochs: skipped (non-finite) steps contributed
@@ -819,6 +916,11 @@ class Trainer:
                 with obs_spans.span("checkpoint", epoch=epoch) as ckpt_span:
                     submit_save(epoch + 1, state, epoch_metrics)
                 ckpt_seconds = ckpt_span.dur_s
+            if self._heartbeat is not None:
+                # Boundary work (checkpoint drain, stopper bookkeeping)
+                # is progress for watchdog purposes.
+                self._heartbeat.progress(phase="epoch_boundary",
+                                         epoch=epoch)
 
             # Per-epoch step-time decomposition: where the wall clock went
             # (host-side timers only — data_wait/h2d/device come from
@@ -1065,9 +1167,54 @@ class Trainer:
         except Exception as exc:  # noqa: BLE001 - advisory only
             self.log(f"profile attribution skipped: {exc}")
 
+    def _make_midsave(self, ckpt, epoch: int, stopper, train_losses: list,
+                      epoch_stats: Dict[str, float], train_data,
+                      base_skips: int, drain_pending):
+        """Build the intra-epoch cadence-save hook (--save_every_steps):
+        an orbax mid/ step whose number encodes the exact resume position
+        plus the trainer_state.json cursor (loss ledger, loader
+        skip-budget ledger) — everything a --resume needs to land on the
+        next batch with parity-exact epoch metrics. Host 0 only (the
+        caller gates on ckpt); no collective runs here, so hosts that
+        skip it stay aligned."""
+        cfg = self.cfg
+        skips_fn = getattr(train_data, "skips_before", None)
+
+        def midsave(st: TrainState, batches_done: int) -> None:
+            drain_pending()
+            with obs_spans.span("midepoch_checkpoint", epoch=epoch,
+                                batch=batches_done):
+                ckpt.save_midepoch(epoch, batches_done, state_to_tree(st))
+                ckpt.wait()
+                skips = (int(skips_fn(batches_done))
+                         if callable(skips_fn) else base_skips)
+                _write_sidecar(cfg.ckpt_dir, {
+                    "epoch": epoch,
+                    "stopper_best": stopper.best,
+                    "stopper_stale": stopper.stale_epochs,
+                    "cursor": {
+                        "epoch": epoch,
+                        "batch_index": int(batches_done),
+                        "opt_step": int(np.asarray(
+                            host_local_array(st.step))),
+                        "seed": cfg.seed,
+                        "skips_used": skips,
+                        "skipped_steps": int(
+                            epoch_stats.get("skipped_steps", 0)),
+                        "loss_ledger": [float(l) for l in train_losses],
+                    },
+                })
+            if self._heartbeat is not None:
+                self._heartbeat.progress(phase="midepoch_checkpoint",
+                                         epoch=epoch)
+
+        return midsave
+
     def _run_train_epoch(self, state: TrainState, train_data: DataSource,
                          epoch: int, train_losses: list,
-                         epoch_stats: Optional[Dict[str, float]] = None) -> TrainState:
+                         epoch_stats: Optional[Dict[str, float]] = None,
+                         start_batch: int = 0, skips_used: int = 0,
+                         save_fn=None) -> TrainState:
         """One epoch of train steps, grouping consecutive same-shape batches
         into K-step scanned dispatches (LoopConfig.steps_per_dispatch).
 
@@ -1085,7 +1232,12 @@ class Trainer:
 
         cfg = self.cfg
         k = max(1, cfg.steps_per_dispatch)
-        step_idx = 0
+        # Mid-epoch resume: numbering continues from the cursor so logs,
+        # ledger indices, and the cadence counter line up with the
+        # uninterrupted run.
+        step_idx = start_batch
+        dispatched = start_batch
+        since_save = 0
         stats = epoch_stats if epoch_stats is not None else {}
         stats.setdefault("skipped_steps", 0)
         # Phase accumulators for the epoch's step-time decomposition
@@ -1200,19 +1352,59 @@ class Trainer:
             """Per-batch fault probes (robustness/faults.py): free when no
             plan is configured. The sigterm probe only *requests*
             preemption — the raise happens at the next dispatch boundary,
-            exactly like a real signal."""
+            exactly like a real signal. ``training.step_crash`` is the
+            hard-crash site (process dies with a traceback, nonzero exit);
+            ``training.hang`` freezes the step loop forever — the wedged-
+            collective simulation only the supervisor watchdog's SIGKILL
+            ends (training/supervisor.py)."""
             for b in items:
                 if faults.fire("train.sigterm") and self._preempt is not None:
                     self._preempt.request("injected SIGTERM (fault plan)")
+                if faults.fire("training.step_crash"):
+                    raise RuntimeError(
+                        "injected training.step_crash fault (chaos plan)")
+                if faults.fire("training.hang"):
+                    _simulate_hang(self.log)
                 yield faults.maybe_poison("train.nan_batch", b)
+
+        def maybe_midsave(current_state) -> None:
+            """Cadence trigger, called after every dispatch: flush the
+            double-buffered metrics first so the cursor's loss ledger
+            covers every batch the saved state contains."""
+            nonlocal pending, since_save
+            if save_fn is None or not 0 < cfg.save_every_steps <= since_save:
+                return
+            if pending is not None:
+                flush(pending)
+                pending = None
+            save_fn(current_state, dispatched)
+            since_save = 0
+
+        def epoch_source():
+            """The epoch's batch stream, honoring a mid-epoch cursor.
+            A cursor-aware loader (BucketedLoader.iter_epoch) skips the
+            already-paid plan entries without loading them; any other
+            DataSource degrades to load-and-drop — slower, same batches."""
+            if not start_batch and not skips_used:
+                return _iter_data(train_data, epoch)
+            iter_ep = getattr(train_data, "iter_epoch", None)
+            if callable(iter_ep):
+                try:
+                    return iter_ep(epoch, start_batch=start_batch,
+                                   skips_used=skips_used)
+                except TypeError:
+                    pass  # pre-cursor source with an iter_epoch of its own
+            src = iter(_iter_data(train_data, epoch))
+            for _ in range(start_batch):
+                next(src, None)
+            return src
 
         # data_wait: host wall time blocked pulling the next same-shape run
         # out of the (possibly prefetching) loader — the input-bound-loop
         # detector. Measured around the iterator's next() because the wait
         # happens inside generator suspension where a `with` cannot reach;
         # each wait is also emitted as a leaf span event.
-        run_iter = iter(
-            _shape_runs(instrumented(_iter_data(train_data, epoch)), k))
+        run_iter = iter(_shape_runs(instrumented(epoch_source()), k))
         while True:
             t_wait = time.perf_counter()
             run = next(run_iter, None)
@@ -1242,6 +1434,9 @@ class Trainer:
                     stats["h2d_s"] += h2d_span.dur_s
                     stats["device_s"] += dev_span.dur_s
                     self._dispatch_count += 1
+                    dispatched += 1
+                    since_save += 1
+                    maybe_midsave(state)
             else:
                 # Buffered batches stay on host until stacked here; ONE
                 # placement per dispatch (device_put-ing each batch first
@@ -1272,6 +1467,9 @@ class Trainer:
                     flush(pending)  # N-1's fetch, after N's async dispatch
                 pending = (stacked, len(run))
                 self._dispatch_count += 1
+                dispatched += len(run)
+                since_save += len(run)
+                maybe_midsave(state)
         if pending is not None:
             flush(pending)
         return state
@@ -1347,6 +1545,19 @@ class Trainer:
         for k, v in metrics.items():
             if isinstance(v, (int, float)) and not math.isnan(float(v)):
                 self._scalar_writer.add_scalar(k, float(v), epoch)
+
+
+def _simulate_hang(log) -> None:
+    """``training.hang`` chaos site: freeze the step loop forever — the
+    wedged-collective simulation. The heartbeat thread (a daemon) keeps
+    the file fresh while ``last_progress_ts`` goes stale, which is
+    exactly the signature the supervisor watchdog SIGKILLs on; nothing
+    else ends this loop, faithfully to a stuck all-reduce. Sleeps in
+    short slices so a debugger still sees a responsive-looking process."""
+    log("training.hang fault injected: step loop frozen until SIGKILL "
+        "(watchdog bait)")
+    while True:
+        time.sleep(0.25)
 
 
 def _is_resource_exhausted(exc: Exception) -> bool:

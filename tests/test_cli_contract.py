@@ -72,7 +72,8 @@ def test_registered_kinds_cover_every_contract_cli():
     whose final line is a machine contract has a registered kind, so a
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
-            "perf_regression", "lint", "fsck", "fleet"} <= set(CONTRACTS)
+            "perf_regression", "lint", "fsck", "fleet",
+            "train_supervise"} <= set(CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
 

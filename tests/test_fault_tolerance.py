@@ -494,12 +494,77 @@ def test_loader_skip_budget_zero_fails_fast():
         list(loader.iter_epoch(0))
 
 
-def test_loader_skip_budget_rejects_multihost_sharding():
+def test_loader_skip_budget_with_shard_is_coordinated_not_rejected():
+    """ISSUE-14 satellite: skip_budget + shard no longer raises — the
+    drop decision is host-0-broadcast (parallel/multihost.agree_any_flag)
+    on a real mesh. In a single process (no coordination client) the
+    agreement degrades to local decisions, which are trivially identical
+    across the one host; the budget/counter semantics are unchanged."""
     from deepinteract_tpu.data.loader import BucketedLoader
 
-    ds = _tiny_dataset(2)
-    with pytest.raises(ValueError, match="unsharded"):
-        BucketedLoader(ds, batch_size=1, shard=(0, 2), skip_budget=1)
+    ds = _tiny_dataset(4)
+    faults.configure({"loader.batch": [2]})
+    loader = BucketedLoader(ds, batch_size=1, prefetch=0, shard=(0, 2),
+                            skip_budget=1)
+    # The loader must not arm the KV protocol without a real multi-host
+    # runtime (it would deadlock a lone process on a blocking get).
+    assert loader._skip_agreement() is None
+    batches = list(loader.iter_epoch(0))
+    plan_len = loader.num_batches()
+    assert len(batches) == plan_len - 1  # one coordinated-style drop
+
+
+def test_agree_any_flag_single_process_is_local_verdict():
+    from deepinteract_tpu.parallel.multihost import agree_any_flag, can_agree
+
+    assert can_agree() is False  # one process, no coordination service
+    assert agree_any_flag("di_test/0", True) is True
+    assert agree_any_flag("di_test/1", False) is False
+
+
+def test_loader_cursor_restarts_on_the_exact_next_batch():
+    """The mid-epoch resume cursor: iter_epoch(start_batch=k) must yield
+    exactly the uninterrupted epoch's batches k.. (plan-position skip, no
+    loading of the paid prefix), byte-identical."""
+    from deepinteract_tpu.data.loader import BucketedLoader
+
+    ds = _tiny_dataset(6)
+    loader = BucketedLoader(ds, batch_size=1, prefetch=0, shuffle=True,
+                            seed=3)
+    full = list(loader.iter_epoch(1))
+    part = list(loader.iter_epoch(1, start_batch=2))
+    assert len(part) == len(full) - 2
+    for a, b in zip(full[2:], part):
+        for la, lb in zip(jax.tree_util.tree_leaves(a),
+                          jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_loader_skip_ledger_and_resume_after_skip():
+    """skips_before feeds the trainer's cursor; a resume that carries
+    skips_used must account the already-consumed budget AND land on the
+    same remaining batches."""
+    from deepinteract_tpu.data.loader import BucketedLoader
+
+    ds = _tiny_dataset(6)
+    loader = BucketedLoader(ds, batch_size=1, prefetch=0, skip_budget=2)
+    faults.configure({"loader.batch": [2]})  # 2nd plan entry corrupt
+    got = list(loader.iter_epoch(0))
+    assert len(got) == 5
+    assert loader.skips_before(1) == 0  # first batch preceded the skip
+    assert loader.skips_before(3) == 1
+    faults.reset()
+    # Resume at consumed=1, skips_used=1: plan entries 0,1 are paid.
+    resumed = list(loader.iter_epoch(0, start_batch=1, skips_used=1))
+    assert len(resumed) == 4
+    for a, b in zip(got[1:], resumed):
+        for la, lb in zip(jax.tree_util.tree_leaves(a),
+                          jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # The carried budget is spent: one more corrupt batch exhausts it.
+    faults.configure({"loader.batch": [1, 2]})
+    with pytest.raises(ValueError, match="injected corrupt complex"):
+        list(loader.iter_epoch(0, start_batch=0, skips_used=1))
 
 
 # ---------------------------------------------------------------------------
@@ -676,6 +741,113 @@ def test_sigterm_flushes_checkpoint_and_resume_reproduces(toy_data, tmp_path):
     assert side_a["epoch"] == side_b["epoch"] == 3
     np.testing.assert_allclose(side_b["stopper_best"], side_a["stopper_best"])
     assert side_b["stopper_stale"] == side_a["stopper_stale"]
+
+
+def test_midepoch_save_and_exact_resume_parity(toy_data, tmp_path):
+    """ISSUE-14 tentpole: --save_every_steps persists a mid/ checkpoint +
+    loader cursor, and a mid-epoch interruption resumes on the EXACT next
+    batch — params bit-equal to the uninterrupted run, the interrupted
+    epoch's logged train_loss/val metrics reproduced exactly (the loss
+    ledger), and re-executed work bounded by the save cadence."""
+    from deepinteract_tpu.training.loop import _read_sidecar
+
+    dir_a = str(tmp_path / "a")
+    trainer_a = _toy_trainer(dir_a, num_epochs=3, save_every_steps=2)
+    state_a = trainer_a.init_state(toy_data[0])
+    state_a, history_a = trainer_a.fit(state_a, toy_data,
+                                       val_data=toy_data[:1])
+
+    # Interrupt at batch 7 = epoch 1, batch 3 (4/epoch): the newest save
+    # is the mid-epoch one at (epoch 1, batch 2).
+    dir_b = str(tmp_path / "b")
+    faults.configure({"train.sigterm": [7]})
+    trainer_b = _toy_trainer(dir_b, num_epochs=3, save_every_steps=2)
+    state_b = trainer_b.init_state(toy_data[0])
+    with pytest.raises(TrainingPreempted):
+        trainer_b.fit(state_b, toy_data, val_data=toy_data[:1])
+    faults.reset()
+    side = _read_sidecar(dir_b)
+    cur = side["cursor"]
+    assert (cur["epoch"], cur["batch_index"]) == (1, 2)
+    assert len(cur["loss_ledger"]) == 2 and cur["opt_step"] == 6
+    assert os.path.isdir(os.path.join(dir_b, "mid"))
+
+    trainer_b2 = _toy_trainer(dir_b, num_epochs=3, save_every_steps=2)
+    state_b2 = trainer_b2.init_state(toy_data[0])
+    state_b2, history_b2 = trainer_b2.fit(state_b2, toy_data,
+                                          val_data=toy_data[:1],
+                                          resume=True)
+    # The interrupted epoch re-entered mid-way and every later epoch ran:
+    # history covers epochs 1..2, and the resumed fit dispatched ONLY the
+    # remaining batches — 2 of epoch 1 plus 4 of epoch 2 (re-paid work
+    # <= the save cadence).
+    assert [h["epoch"] for h in history_b2] == [1, 2]
+    assert trainer_b2._dispatch_count == 2 + 4
+    assert int(state_b2.step) == int(state_a.step) == 12
+    for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                    jax.tree_util.tree_leaves(state_b2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Metric parity is EXACT, including the interrupted epoch's
+    # train_loss (prefilled from the cursor's loss ledger).
+    for got, ref in zip(history_b2, history_a[1:]):
+        assert got["train_loss"] == ref["train_loss"]
+        assert got["val_ce"] == ref["val_ce"]
+
+
+def test_midepoch_resume_survives_missing_cursor_sidecar(toy_data,
+                                                         tmp_path):
+    """Kill between the mid/ orbax save and the sidecar write: the resume
+    position comes from the step NUMBER (training/checkpoint.py
+    decode_position), so the run still lands on the exact next batch —
+    only the interrupted epoch's logged train_loss degrades to the
+    re-run batches (weights stay bit-exact)."""
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    trainer_a = _toy_trainer(dir_a, num_epochs=2, save_every_steps=2)
+    state_a = trainer_a.init_state(toy_data[0])
+    state_a, _ = trainer_a.fit(state_a, toy_data, val_data=toy_data[:1])
+
+    faults.configure({"train.sigterm": [7]})
+    trainer_b = _toy_trainer(dir_b, num_epochs=2, save_every_steps=2)
+    state_b = trainer_b.init_state(toy_data[0])
+    with pytest.raises(TrainingPreempted):
+        trainer_b.fit(state_b, toy_data, val_data=toy_data[:1])
+    faults.reset()
+    os.unlink(os.path.join(dir_b, "trainer_state.json"))  # the tear
+
+    trainer_b2 = _toy_trainer(dir_b, num_epochs=2, save_every_steps=2)
+    state_b2 = trainer_b2.init_state(toy_data[0])
+    state_b2, history_b2 = trainer_b2.fit(state_b2, toy_data,
+                                          val_data=toy_data[:1],
+                                          resume=True)
+    assert [h["epoch"] for h in history_b2] == [1]
+    assert trainer_b2._dispatch_count == 2  # exact position held
+    assert int(state_b2.step) == int(state_a.step)
+    for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                    jax.tree_util.tree_leaves(state_b2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_training_step_crash_fault_site_raises(toy_data):
+    """training.step_crash is the supervisor chaos hook: the run dies
+    with a traceback (nonzero exit through cli.train), not a hang."""
+    faults.configure({"training.step_crash": [2]})
+    trainer = _toy_trainer(num_epochs=1)
+    state = trainer.init_state(toy_data[0])
+    with pytest.raises(RuntimeError, match="injected training.step_crash"):
+        trainer.fit(state, toy_data)
+    assert faults.call_count("training.step_crash") == 2
+
+
+def test_training_hang_fault_site_counts_without_firing(toy_data):
+    """The hang site freezes forever when it fires (only SIGKILL ends
+    it — exercised end-to-end in test_training_supervisor.py), so the
+    in-process check pins the probe's plumbing: it is consulted per
+    batch and stays silent off-plan."""
+    faults.configure({"training.hang": []})  # armed site, no firing call
+    trainer = _toy_trainer(num_epochs=1)
+    state = trainer.init_state(toy_data[0])
+    trainer.fit(state, toy_data)
+    assert faults.call_count("training.hang") == 4  # probed every batch
 
 
 def test_resume_restores_optimizer_state_and_best_k(toy_data, tmp_path):

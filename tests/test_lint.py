@@ -91,14 +91,16 @@ def test_repo_wide_suppressions_are_intentional(capsys):
     main([])
     rec = json.loads(
         [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
-    # 18 = 10 pre-ISSUE-12 pragmas + 8 artifact-write waivers (streaming
+    # 19 = 10 pre-ISSUE-12 pragmas + 9 artifact-write waivers (streaming
     # sinks whose readers tolerate a torn tail — including the fleet
     # supervisor's append-only child-process logs (ISSUE-13) —
-    # transient/regenerable offline build outputs, and the download
-    # fetch whose atomicity is the verified move) — every other
-    # write-mode open() was converted to robustness/artifacts.
-    # atomic_write (the fleet_state.json supervisor state does).
-    assert rec["suppressed"] <= 18, (
+    # transient/regenerable outputs incl. the ISSUE-14 synthetic split
+    # fixtures, and the download fetch whose atomicity is the verified
+    # move) — every other write-mode open() was converted to robustness/
+    # artifacts.atomic_write (train_supervisor_state.json does; the
+    # train_supervise/v1 contract prints from cli/train.py, which the
+    # no-print rule exempts).
+    assert rec["suppressed"] <= 19, (
         "suppression count grew — justify or fix the new ones")
 
 

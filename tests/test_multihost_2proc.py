@@ -15,18 +15,16 @@ analog: Lightning DDP over 2 nodes, lit_model_train.py:217,226).
 
 from __future__ import annotations
 
+import json
 import os
 import re
+import signal
 import socket
 import subprocess
 import sys
+import time
 
-import numpy as np
 import pytest
-
-from deepinteract_tpu.data.features import featurize_chain
-from deepinteract_tpu.data.io import save_complex_npz
-from deepinteract_tpu.data.synthetic import random_backbone, random_residue_feats
 
 
 def _free_port() -> int:
@@ -38,31 +36,12 @@ def _free_port() -> int:
 def _build_tiny_dataset(root: str, n_complexes: int = 5) -> None:
     """Synthetic npz dataset + split files; 5 same-bucket train complexes
     at global batch 2 (1 local x 2 hosts, drop_remainder) -> 2 coordinated
-    steps per epoch, odd complex dropped."""
-    processed = os.path.join(root, "processed")
-    os.makedirs(processed, exist_ok=True)
-    rng = np.random.default_rng(0)
-    names = []
-    for i in range(n_complexes):
-        raws = []
-        cas = []
-        for n, origin in ((24, np.zeros(3)), (21, np.array([10.0, 0.0, 0.0]))):
-            bb = random_backbone(n, rng, origin=origin)
-            raws.append(featurize_chain(bb, random_residue_feats(n, rng),
-                                        knn=6, geo_nbrhd_size=2, rng=rng))
-            cas.append(bb[:, 1, :])
-        d = np.linalg.norm(cas[0][:, None] - cas[1][None, :], axis=-1)
-        contact = (d < 8.0).astype(np.int32)
-        ii, jj = np.meshgrid(np.arange(24), np.arange(21), indexing="ij")
-        examples = np.stack([ii.ravel(), jj.ravel(), contact.ravel()],
-                            axis=1).astype(np.int32)
-        name = f"c{i}.npz"
-        save_complex_npz(os.path.join(processed, name), raws[0], raws[1],
-                         examples, complex_name=f"c{i}")
-        names.append(name)
-    for mode, sel in (("train", names), ("val", names[:1]), ("test", names[:1])):
-        with open(os.path.join(root, f"pairs-postprocessed-{mode}.txt"), "w") as f:
-            f.write("\n".join(sel) + "\n")
+    steps per epoch, odd complex dropped. Thin wrapper over the ONE
+    shared builder (data/synthetic.py write_tiny_npz_dataset — also
+    behind the supervised chaos tests and bench's recovery section)."""
+    from deepinteract_tpu.data.synthetic import write_tiny_npz_dataset
+
+    write_tiny_npz_dataset(root, n_complexes=n_complexes, seed=0)
 
 
 TINY_FLAGS = [
@@ -73,11 +52,13 @@ TINY_FLAGS = [
 ]
 
 
-def _run_two_procs(tmp_path, root, tag, extra_flags=(), extra_env=None,
-                   num_epochs=1, timeout=1500):
-    """Launch one coordinated 2-process cli.train run; returns the two
-    stdout captures. Workdirs are ``<tmp>/<tag>_host{0,1}`` (stable per
-    tag so a rerun with --resume finds its checkpoints)."""
+def _launch_two_procs(tmp_path, root, tag, extra_flags=(), extra_env=None,
+                      num_epochs=1, per_host_env=None):
+    """Start one coordinated 2-process cli.train run; returns the Popen
+    pair. Workdirs are ``<tmp>/<tag>_host{0,1}`` (stable per tag so a
+    rerun with --resume finds its checkpoints). ``per_host_env`` maps
+    host index -> extra env for THAT host only (e.g. a fault plan on one
+    host of the mesh)."""
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -90,6 +71,7 @@ def _run_two_procs(tmp_path, root, tag, extra_flags=(), extra_env=None,
             JAX_TRACEBACK_FILTERING="off",
         )
         env.update(extra_env or {})
+        env.update((per_host_env or {}).get(pid, {}))
         cmd = [
             sys.executable, "-m", "deepinteract_tpu.cli.train",
             "--dips_root", str(root),
@@ -106,6 +88,10 @@ def _run_two_procs(tmp_path, root, tag, extra_flags=(), extra_env=None,
                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                              text=True)
         )
+    return procs
+
+
+def _join_two_procs(procs, tag, timeout=1500):
     outs = []
     for pid, proc in enumerate(procs):
         try:
@@ -119,6 +105,15 @@ def _run_two_procs(tmp_path, root, tag, extra_flags=(), extra_env=None,
         assert proc.returncode == 0, (
             f"{tag} process {pid} failed:\n{out[-6000:]}")
     return outs
+
+
+def _run_two_procs(tmp_path, root, tag, extra_flags=(), extra_env=None,
+                   num_epochs=1, timeout=1500, per_host_env=None):
+    """Launch + join one coordinated 2-process cli.train run; returns the
+    two stdout captures."""
+    procs = _launch_two_procs(tmp_path, root, tag, extra_flags, extra_env,
+                              num_epochs, per_host_env)
+    return _join_two_procs(procs, tag, timeout)
 
 
 def _epoch_line(out: str, epoch: int) -> str:
@@ -264,3 +259,113 @@ def test_two_process_kill_after_save_resume_parity(tmp_path):
     assert not (tmp_path / "chaos_host1" / "ckpt" / "last").exists()
     assert not (tmp_path / "chaos_host1" / "ckpt" / "best").exists()
     assert not (tmp_path / "chaos_host1" / "test_top_metrics.csv").exists()
+
+
+def _read_json(path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_two_process_midepoch_kill9_supervised_resume_parity(tmp_path):
+    """ISSUE-14 satellite: a pod-wide kill -9 MID-EPOCH (not after a
+    boundary save — the --save_every_steps mid/ checkpoint is the newest
+    state) under per-host training supervisors. Both hosts' children are
+    hard-killed; both supervisors restart them into --resume with no
+    human input; the finished run's per-epoch metric lines must match
+    the uninterrupted reference EXACTLY on both hosts, artifacts stay
+    rank-0-only, and both final lines are honest train_supervise/v1
+    contracts with restarts >= 1."""
+    from tools.check_cli_contract import check_cli_contract_text
+
+    root = tmp_path / "data"
+    _build_tiny_dataset(str(root))
+    supervise_flags = (
+        "--supervise", "--save_every_steps", "1",
+        "--heartbeat_seconds", "0.2", "--watch_interval_s", "0.1",
+        "--hang_timeout_s", "120", "--start_grace_s", "600",
+        "--train_restart_backoff_s", "0.3")
+
+    ref_outs = _run_two_procs(tmp_path, root, "ref", num_epochs=3,
+                              extra_flags=("--save_every_steps", "1"))
+    ref_lines = {e: [_epoch_line(out, e) for out in ref_outs]
+                 for e in (0, 1, 2)}
+    for e in ref_lines:
+        assert ref_lines[e][0] == ref_lines[e][1]
+
+    procs = _launch_two_procs(tmp_path, root, "sup", num_epochs=3,
+                              extra_flags=supervise_flags)
+    # Wait for host 0's mid-epoch-1 cursor (epoch 1, batch >= 1: past a
+    # mid/ save, before the boundary), then kill -9 BOTH children — the
+    # pod-preemption shape.
+    sidecar = tmp_path / "sup_host0" / "ckpt" / "trainer_state.json"
+    state_paths = [tmp_path / f"sup_host{i}" / "ckpt"
+                   / "train_supervisor_state.json" for i in (0, 1)]
+    killed = None
+    deadline = time.time() + 900
+    while time.time() < deadline and killed is None:
+        time.sleep(0.05)
+        cur = (_read_json(sidecar) or {}).get("cursor") or {}
+        if cur.get("epoch") == 1 and cur.get("batch_index", 0) >= 1:
+            pids = [(_read_json(p) or {}).get("child_pid")
+                    for p in state_paths]
+            if all(pids):
+                for pid in pids:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+                killed = dict(cur)
+    assert killed is not None, "never saw host 0's mid-epoch cursor"
+    outs = _join_two_procs(procs, "sup", timeout=900)
+
+    for host, out in enumerate(outs):
+        rec = check_cli_contract_text(out, "train_supervise")
+        assert rec["ok"] is True and rec["restarts"] >= 1, (host, rec)
+        assert rec["circuit_open"] is False
+        for e in (0, 1, 2):
+            assert _epoch_line(out, e) == ref_lines[e][host], (host, e)
+    # Host 0 announced the exact mid-epoch landing; host 1 received the
+    # position by broadcast (only rank 0 holds the Checkpointer).
+    assert (f"resumed from epoch {killed['epoch']}, batch "
+            f"{killed['batch_index']}") in outs[0]
+    # Rank-0-only artifacts, including the new mid/ root.
+    assert (tmp_path / "sup_host0" / "ckpt" / "mid").is_dir()
+    assert not (tmp_path / "sup_host1" / "ckpt" / "last").exists()
+    assert not (tmp_path / "sup_host1" / "ckpt" / "mid").exists()
+    assert not (tmp_path / "sup_host1" / "test_top_metrics.csv").exists()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_two_process_skip_budget_drop_is_host0_broadcast(tmp_path):
+    """ISSUE-14 satellite: --data_skip_budget on a mesh. A corrupt batch
+    on ONE host (fault plan injected into host 1 only) must be dropped
+    by BOTH hosts — the decision is host-0-broadcast through the
+    coordination KV store — so step counts stay aligned and the run
+    finishes instead of deadlocking in a collective."""
+    root = tmp_path / "data"
+    _build_tiny_dataset(str(root))
+    outs = _run_two_procs(
+        tmp_path, root, "skip", num_epochs=2,
+        extra_flags=("--data_skip_budget", "1"),
+        # Call @6 of host 1's loader.batch site lands on an EPOCH-1
+        # train batch whichever way the example-fetch prefetch races
+        # (abandoned-iterator calls ∈ {1,2}; epoch-0 train = 2, epoch-0
+        # val = 1, so epoch-1 train spans calls {5,6} or {6,7} — @6 is
+        # in both). Host 0 loads the same entry fine.
+        per_host_env={1: {"DI_FAULTS": "loader.batch=@6"}})
+    assert "host-0-coordinated" in outs[0]
+    # Host 1 skipped its locally-corrupt batch; host 0 skipped the SAME
+    # batch on the broadcast verdict despite loading it fine.
+    assert "injected corrupt complex" in outs[1]
+    assert "peer-host load failure (coordinated drop)" in outs[0]
+    # Aligned epochs all the way to a clean coordinated exit: per-epoch
+    # lines agree across hosts (a desynced skip would have deadlocked
+    # long before any line printed).
+    for e in (0, 1):
+        assert _epoch_line(outs[0], e) == _epoch_line(outs[1], e)

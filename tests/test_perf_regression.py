@@ -163,6 +163,51 @@ def test_rollover_keys_gate_including_zero_baseline_drops():
                for r in verdict["regressions"])
 
 
+def test_recovery_keys_gate_including_cadence_ceiling():
+    """ISSUE-14 satellite: the bench `recovery` keys gate. A zero
+    steps_reexecuted baseline (kill landed exactly on a save) still
+    bounds the fresh run — re-paying more than one --save_every_steps
+    cadence means the cursor or mid/ checkpoint stopped landing — and
+    MTTR blowing past its band regresses."""
+    base = dict(GOOD, recovery={"mttr_s": 25.0, "steps_reexecuted": 0})
+    verdict = compare(dict(base), base)
+    assert verdict["ok"]
+    assert {"recovery.mttr_s",
+            "recovery.steps_reexecuted"} <= set(verdict["compared"])
+    # Within the cadence ceiling (2): clean even from a 0 baseline.
+    within = dict(GOOD, recovery={"mttr_s": 25.0, "steps_reexecuted": 2})
+    assert compare(within, base)["ok"]
+    # Past the cadence: regression despite the 0 baseline.
+    over = dict(GOOD, recovery={"mttr_s": 25.0, "steps_reexecuted": 3})
+    verdict = compare(over, base)
+    (reg,) = verdict["regressions"]
+    assert reg["key"] == "recovery.steps_reexecuted"
+    assert "ceiling" in reg["detail"] and not verdict["ok"]
+    # MTTR collapse (supervisor stopped recovering promptly) regresses.
+    slow = dict(GOOD, recovery={"mttr_s": 120.0, "steps_reexecuted": 0})
+    verdict = compare(slow, base)
+    assert {r["key"] for r in verdict["regressions"]} == {
+        "recovery.mttr_s"}
+    # Losing a recovery key entirely is the plumbing class.
+    lost = dict(GOOD, recovery={"mttr_s": 25.0})
+    verdict = compare(lost, base)
+    assert any(r["kind"] == "plumbing"
+               and r["key"] == "recovery.steps_reexecuted"
+               for r in verdict["regressions"])
+    # The ceiling follows the contract's OWN cadence when present
+    # (DI_BENCH_RECOVERY_CADENCE runs must not gate against the default
+    # 2): 4 re-executed steps at cadence 4 is clean, 5 regresses.
+    cad4 = dict(GOOD, recovery={"mttr_s": 25.0, "steps_reexecuted": 4,
+                                "save_every_steps": 4})
+    assert compare(cad4, base)["ok"]
+    cad4_over = dict(GOOD, recovery={"mttr_s": 25.0,
+                                     "steps_reexecuted": 5,
+                                     "save_every_steps": 4})
+    verdict = compare(cad4_over, base)
+    assert not verdict["ok"]
+    assert verdict["regressions"][0]["key"] == "recovery.steps_reexecuted"
+
+
 def test_missing_perf_key_is_a_plumbing_regression():
     """The generalized "parsed": null class: a key the baseline carried
     that the fresh contract lost fails loudly, never silently passes."""

@@ -1,0 +1,538 @@
+"""Self-healing training chaos suite (ISSUE-14 acceptance).
+
+Two tiers in one module, both fast enough for tier-1:
+
+* **stub children** — supervision mechanics (crash->backoff restart,
+  hang detection off heartbeat progress staleness, circuit breaker,
+  preemption forward, atomic state persistence) driven against tiny
+  python stub processes, no jax import in the child;
+* **real cli.train e2e** — the acceptance walks: a supervised training
+  child killed -9 MID-EPOCH auto-restarts into an exact mid-epoch
+  resume whose final metrics match the uninterrupted run line-for-line,
+  and a ``training.hang``-injected child is detected by heartbeat
+  progress staleness, SIGKILLed, and resumed to completion — no human
+  in either loop. The module shares one synthetic dataset and one XLA
+  compile cache across its subprocess runs to stay inside the tier-1
+  budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deepinteract_tpu.training.supervisor import (
+    SuperviseConfig,
+    TrainingSupervisor,
+    strip_supervisor_flags,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.check_cli_contract import check_cli_contract_text  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# units
+
+
+def test_strip_supervisor_flags_removes_only_supervisor_knobs():
+    argv = ["--dips_root", "d", "--supervise", "--hang_timeout_s", "5",
+            "--resume", "--watch_interval_s=0.2", "--seed", "7"]
+    assert strip_supervisor_flags(argv) == [
+        "--dips_root", "d", "--resume", "--seed", "7"]
+
+
+def test_midepoch_step_encoding_roundtrip_and_ordering():
+    from deepinteract_tpu.training.checkpoint import (
+        decode_position,
+        encode_midepoch_step,
+    )
+
+    assert decode_position("mid", encode_midepoch_step(3, 17)) == (3, 17)
+    assert decode_position("last", 4) == (4, 0)
+    assert decode_position("best", 2) == (2, 0)
+    # Monotone over a run: mid saves of epoch e sort after epoch e's
+    # boundary (step e) and before epoch e+1's (step e+1) by position.
+    assert (decode_position("last", 1) < decode_position("mid",
+            encode_midepoch_step(1, 2)) < decode_position("last", 2))
+    with pytest.raises(ValueError):
+        encode_midepoch_step(1, 10 ** 9)
+
+
+# ---------------------------------------------------------------------------
+# stub-child supervision mechanics (no jax in the child)
+
+
+def _stub_cfg(tmp_path, **kw):
+    kw.setdefault("heartbeat_seconds", 0.2)
+    kw.setdefault("poll_interval_s", 0.05)
+    kw.setdefault("hang_timeout_s", 1.5)
+    kw.setdefault("start_grace_s", 1.0)
+    kw.setdefault("restart_backoff_s", 0.05)
+    kw.setdefault("restart_backoff_max_s", 0.1)
+    return SuperviseConfig(
+        heartbeat_path=str(tmp_path / "hb.json"),
+        state_dir=str(tmp_path), **kw)
+
+
+def _beating_child(hb_path, body, marker=None):
+    """A stub that beats fresh heartbeats, then runs ``body``."""
+    return f"""
+import json, os, sys, time
+hb = {hb_path!r}
+marker = {marker!r}
+def beat(progress=True):
+    now = time.time()
+    payload = {{"written_ts": now, "step": 1, "epoch": 0}}
+    payload["last_progress_ts"] = now if progress else 0.0
+    open(hb, "w").write(json.dumps(payload))
+for _ in range(3):
+    beat(); time.sleep(0.05)
+{body}
+"""
+
+
+def test_crash_restarts_into_resume_and_reports(tmp_path):
+    marker = str(tmp_path / "ran_once")
+    body = f"""
+if not os.path.exists({marker!r}):
+    open({marker!r}, "w").write("1")
+    sys.exit(9)
+assert "--resume" in sys.argv  # restarts resume, first runs do not
+sys.exit(0)
+"""
+    seen = []
+
+    def cmd_fn(resume, attempt):
+        seen.append(resume)
+        cmd = [sys.executable, "-c",
+               _beating_child(str(tmp_path / "hb.json"), body)]
+        return cmd + (["--resume"] if resume else [])
+
+    sup = TrainingSupervisor(cmd_fn, _stub_cfg(tmp_path))
+    rc = sup.run()
+    c = sup.contract()
+    assert rc == 0 and c["ok"] is True
+    assert c["restarts"] == 1 and c["crashes"] == 1 and c["spawns"] == 2
+    assert seen == [False, True]
+    state = json.load(open(sup.state_path))
+    assert state["state"] == "finished" and state["restarts"] == 1
+
+
+def test_hang_detected_by_progress_staleness_and_resumed(tmp_path):
+    """Fresh written_ts + stale last_progress_ts (the beat thread lives,
+    the step loop does not) must be SIGKILLed and restarted — the
+    wedged-collective signature."""
+    marker = str(tmp_path / "hung_once")
+    body = f"""
+if not os.path.exists({marker!r}):
+    open({marker!r}, "w").write("1")
+    while True:  # beat forever, progress never
+        beat(progress=False); time.sleep(0.05)
+sys.exit(0)
+"""
+
+    def cmd_fn(resume, attempt):
+        return [sys.executable, "-c",
+                _beating_child(str(tmp_path / "hb.json"), body)]
+
+    sup = TrainingSupervisor(cmd_fn, _stub_cfg(tmp_path))
+    rc = sup.run()
+    c = sup.contract()
+    assert rc == 0 and c["ok"] is True
+    assert c["hang_kills"] == 1 and c["restarts"] == 1
+    assert c["crashes"] == 0  # a hang kill is not a crash
+
+
+def test_circuit_breaker_opens_and_exit_is_nonzero(tmp_path):
+    def cmd_fn(resume, attempt):  # dies instantly, forever
+        return [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+    sup = TrainingSupervisor(
+        cmd_fn, _stub_cfg(tmp_path, circuit_max_restarts=3,
+                          circuit_window_s=60.0))
+    rc = sup.run()
+    c = sup.contract()
+    assert rc != 0
+    assert c["circuit_open"] is True and c["ok"] is False
+    assert c["restarts"] < 3 + 1  # the breaker capped the loop
+    state = json.load(open(sup.state_path))
+    assert state["state"] == "circuit_open"
+
+
+def test_contract_passes_registered_kind(tmp_path):
+    """The train_supervise/v1 kind is validated against the REAL record
+    builder (the same dict cli.train --supervise prints as its final
+    stdout line — the subprocess e2e tests below validate that capture
+    too)."""
+
+    def cmd_fn(resume, attempt):
+        return [sys.executable, "-c", "pass"]
+
+    sup = TrainingSupervisor(cmd_fn, _stub_cfg(tmp_path))
+    rc = sup.run()
+    assert rc == 0
+    rec = check_cli_contract_text(json.dumps(sup.contract()),
+                                  "train_supervise")
+    assert rec["schema"] == "train_supervise/v1"
+    assert rec["ok"] is True and rec["restarts"] == 0
+
+
+def test_sigterm_forward_drains_child_preempted_exit_zero(tmp_path):
+    """Preemption discipline: SIGTERM to the supervisor forwards to the
+    child (whose own guard exits 0) and the supervisor exits 0 with
+    preempted=true — the scheduler restarts the whole stack later."""
+    import threading
+
+    child = f"""
+import json, signal, sys, time
+signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+hb = {str(tmp_path / "hb.json")!r}
+for _ in range(2000):
+    now = time.time()
+    open(hb, "w").write(json.dumps(
+        {{"written_ts": now, "last_progress_ts": now}}))
+    time.sleep(0.05)
+"""
+
+    def cmd_fn(resume, attempt):
+        return [sys.executable, "-c", child]
+
+    sup = TrainingSupervisor(cmd_fn, _stub_cfg(tmp_path))
+
+    def preempt():
+        # Signal only once the first beat landed — proof the child's
+        # SIGTERM handler is installed (interpreter startup raced a
+        # too-eager forward into the default-action kill otherwise).
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if ((tmp_path / "hb.json").exists() and sup.proc is not None
+                    and sup.proc.poll() is None):
+                sup._on_signal(signal.SIGTERM, None)
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=preempt, daemon=True)
+    t.start()
+    rc = sup.run()
+    t.join(timeout=10.0)
+    c = sup.contract()
+    assert rc == 0 and c["preempted"] is True and c["ok"] is True
+    assert c["restarts"] == 0
+
+
+def test_sigterm_during_backoff_exits_preempted_without_respawn(tmp_path):
+    """A preemption landing while NO child is alive (the restart-backoff
+    window) must not be ignored: respawning would train past the
+    preemption deadline. The supervisor exits 0 preempted, and the crash
+    count proves no further child ran."""
+    import threading
+
+    def cmd_fn(resume, attempt):
+        return [sys.executable, "-c", "import sys; sys.exit(4)"]
+
+    sup = TrainingSupervisor(
+        cmd_fn, _stub_cfg(tmp_path, restart_backoff_s=3.0,
+                          restart_backoff_max_s=3.0))
+
+    def preempt_during_backoff():
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if sup.crashes >= 1:  # first child reaped, backoff running
+                sup._on_signal(signal.SIGTERM, None)
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=preempt_during_backoff, daemon=True)
+    t.start()
+    rc = sup.run()
+    t.join(timeout=10.0)
+    c = sup.contract()
+    assert rc == 0 and c["preempted"] is True
+    assert c["spawns"] == 1  # the drain was honored, no respawn
+    assert c["state"] == "preempted"
+
+
+def test_child_heartbeat_matched_by_pid_not_filename(tmp_path):
+    """Auto-detected multi-host topologies: the child's process index —
+    and so its heartbeat filename — is unknowable before jax initializes
+    in the child, and a previous incarnation's (or a peer host's) file
+    must never be judged in its place. The watchdog matches the beat to
+    the CHILD PID riding the payload's host tag."""
+    hb_dir = tmp_path / "obs"
+    hb_dir.mkdir()
+    # Child writes heartbeat_p1.json (host:pid of itself); a stale
+    # foreign file sits at the configured p0 path.
+    child = f"""
+import json, os, socket, sys, time
+path = {str(hb_dir / "heartbeat_p1.json")!r}
+for _ in range(200):
+    now = time.time()
+    open(path, "w").write(json.dumps(
+        {{"written_ts": now, "last_progress_ts": 0.0, "step": 1,
+          "host": f"{{socket.gethostname()}}:{{os.getpid()}}"}}))
+    time.sleep(0.05)
+"""
+    (hb_dir / "heartbeat_p0.json").write_text(json.dumps(
+        {"written_ts": time.time(), "last_progress_ts": time.time(),
+         "host": "elsewhere:99999999"}))
+
+    def cmd_fn(resume, attempt):
+        return [sys.executable, "-c", child]
+
+    cfg = SuperviseConfig(
+        heartbeat_path=str(hb_dir / "heartbeat_p0.json"),
+        state_dir=str(tmp_path), heartbeat_seconds=0.2,
+        poll_interval_s=0.05, hang_timeout_s=1.0, start_grace_s=0.5,
+        restart_backoff_s=0.05, restart_backoff_max_s=0.1,
+        circuit_max_restarts=2, circuit_window_s=60.0)
+    sup = TrainingSupervisor(cmd_fn, cfg)
+    rc = sup.run()
+    c = sup.contract()
+    # The p0 file shows fresh progress, but the CHILD's own beat (p1)
+    # shows a frozen step loop — the watchdog must believe the child,
+    # hang-kill it, and (the child re-hangs) eventually trip the circuit.
+    assert c["hang_kills"] >= 1, c
+    assert rc != 0 and c["circuit_open"] is True
+
+
+def test_loader_shard_without_coordination_client_raises(tmp_path,
+                                                         monkeypatch):
+    """A REAL multi-process mesh whose coordination client is missing
+    (jax internals moved) must refuse the armed skip budget loudly —
+    host-local drop decisions would silently desync the mesh."""
+    import test_fault_tolerance as ft
+
+    from deepinteract_tpu.data.loader import BucketedLoader
+    from deepinteract_tpu.parallel import multihost
+
+    ds = ft._tiny_dataset(4)
+    loader = BucketedLoader(ds, batch_size=1, prefetch=0, shard=(0, 2),
+                            skip_budget=1)
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost, "_coordination_client", lambda: None)
+    with pytest.raises(RuntimeError, match="coordination client"):
+        loader._skip_agreement()
+
+
+def test_restart_strips_fault_plan_from_child_env(tmp_path):
+    marker = str(tmp_path / "ran_once")
+    body = f"""
+assert ("DI_FAULTS" in os.environ) == (not os.path.exists({marker!r}))
+if not os.path.exists({marker!r}):
+    open({marker!r}, "w").write("1")
+    sys.exit(5)
+sys.exit(0)
+"""
+
+    def cmd_fn(resume, attempt):
+        return [sys.executable, "-c",
+                _beating_child(str(tmp_path / "hb.json"), body)]
+
+    env = dict(os.environ, DI_FAULTS="training.hang=@3")
+    sup = TrainingSupervisor(cmd_fn, _stub_cfg(tmp_path), env=env)
+    assert sup.run() == 0
+    assert sup.contract()["restarts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fsck over the self-healing artifacts (ISSUE-14 satellite)
+
+
+def test_fsck_reports_cursor_supervisor_state_and_stale_hosts(tmp_path,
+                                                              capsys):
+    from deepinteract_tpu.cli.fsck import main as fsck_main
+    from deepinteract_tpu.robustness import artifacts
+
+    run = tmp_path / "run"
+    (run / "obs").mkdir(parents=True)
+    # A healthy mid-epoch cursor riding a verified trainer_state.json.
+    artifacts.atomic_write_artifact(
+        str(run / "trainer_state.json"),
+        json.dumps({"epoch": 1, "stopper_best": 0.5, "stopper_stale": 0,
+                    "cursor": {"epoch": 1, "batch_index": 2,
+                               "opt_step": 6, "seed": 7, "skips_used": 0,
+                               "skipped_steps": 0,
+                               "loss_ledger": [0.4, 0.2]}}),
+        "trainer-state")
+    # A parseable supervisor state file (known sidecar-less artifact).
+    artifacts.atomic_write(str(run / "train_supervisor_state.json"),
+                           json.dumps({"state": "running", "restarts": 1}))
+    # A stale training heartbeat naming its host.
+    (run / "obs" / "heartbeat_p3.json").write_text(json.dumps(
+        {"written_ts": time.time() - 9999, "process_index": 3}))
+    rc = fsck_main([str(run)])
+    rec = check_cli_contract_text(capsys.readouterr().out, "fsck")
+    assert rc == 0 and rec["ok"] is True
+    assert rec["resume_cursor"] == {"epoch": 1, "batch_index": 2,
+                                    "opt_step": 6, "skips_used": 0}
+    assert rec["stale_heartbeats"] == 1
+    assert rec["stale_heartbeat_hosts"] == [3]
+
+    # A structurally damaged cursor is corruption: quarantined, and the
+    # second pass converges clean (the run resumes at epoch boundary).
+    artifacts.atomic_write_artifact(
+        str(run / "trainer_state.json"),
+        json.dumps({"epoch": 1, "cursor": {"epoch": "one",
+                                           "loss_ledger": "oops"}}),
+        "trainer-state")
+    rc = fsck_main([str(run), "--quarantine"])
+    rec = check_cli_contract_text(capsys.readouterr().out, "fsck")
+    assert rc == 0 and rec["corrupt"] == 1 and rec["quarantined"] == 1
+    assert rec["resume_cursor"] is None
+    assert "cursor" in rec["corrupt_paths"][0] or rec["corrupt_paths"] \
+        == [str(run / "trainer_state.json")]
+
+
+# ---------------------------------------------------------------------------
+# real cli.train e2e: the ISSUE-14 acceptance walks
+
+
+TINY = ["--num_gnn_layers", "1", "--num_gnn_hidden_channels", "8",
+        "--num_gnn_attention_heads", "2", "--num_interact_layers", "1",
+        "--num_interact_hidden_channels", "8", "--steps_per_dispatch", "1",
+        "--log_every", "1", "--seed", "7", "--num_epochs", "3"]
+
+
+@pytest.fixture(scope="module")
+def train_env(tmp_path_factory):
+    """One synthetic dataset + one XLA compile cache for every
+    subprocess run in this module — repeat compiles become disk reads,
+    which is what keeps three train children inside the tier-1 budget."""
+    from deepinteract_tpu.data.synthetic import write_tiny_npz_dataset
+
+    base = tmp_path_factory.mktemp("selfheal")
+    root = base / "data"
+    write_tiny_npz_dataset(str(root), n_complexes=4, seed=0)
+    return {"root": str(root), "cache": str(base / "compile_cache")}
+
+
+def _train_cmd(train_env, ckpt_dir, extra):
+    return [sys.executable, "-m", "deepinteract_tpu.cli.train",
+            "--dips_root", train_env["root"], "--ckpt_dir", str(ckpt_dir),
+            "--compile_cache_dir", train_env["cache"]] + TINY + list(extra)
+
+
+def _run(cmd, cwd, timeout=420, env_extra=None, popen=False):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    if popen:
+        return subprocess.Popen(cmd, cwd=str(cwd), env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+    proc = subprocess.run(cmd, cwd=str(cwd), env=env, timeout=timeout,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    return proc
+
+
+def _epoch_lines(out: str):
+    """Per-epoch metric lines, host wall clocks stripped; keyed by epoch
+    with the LAST occurrence winning (a resumed run reprints the
+    interrupted epoch's line)."""
+    lines = {}
+    for line in out.splitlines():
+        m = re.match(r"epoch (\d+): ", line)
+        if m:
+            lines[int(m.group(1))] = re.sub(
+                r" (?:train|val)_s=[0-9.]+", "", line)
+    return lines
+
+
+def _read_json(path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def test_supervised_kill9_midepoch_resumes_with_exact_parity(
+        tmp_path, train_env):
+    """THE acceptance walk: kill -9 a supervised training child
+    mid-epoch (after a mid/ cadence save), let the supervisor restart it
+    with no human input, and require the finished run's per-epoch metric
+    lines to match the uninterrupted reference EXACTLY — with re-paid
+    work bounded by --save_every_steps."""
+    ref = _run(_train_cmd(train_env, tmp_path / "ref", []), tmp_path)
+    assert ref.returncode == 0, ref.stdout[-4000:]
+    ref_lines = _epoch_lines(ref.stdout)
+    assert set(ref_lines) == {0, 1, 2}
+
+    ckpt = tmp_path / "ckpt"
+    proc = _run(_train_cmd(train_env, ckpt, [
+        "--supervise", "--save_every_steps", "1",
+        "--heartbeat_seconds", "0.2", "--watch_interval_s", "0.1",
+        "--hang_timeout_s", "60", "--start_grace_s", "300",
+        "--train_restart_backoff_s", "0.2"]), tmp_path, popen=True)
+    state_path = ckpt / "train_supervisor_state.json"
+    sidecar = ckpt / "trainer_state.json"
+    killed = None
+    deadline = time.time() + 300
+    while time.time() < deadline and killed is None:
+        time.sleep(0.05)
+        cur = (_read_json(sidecar) or {}).get("cursor") or {}
+        if cur.get("epoch") == 1 and cur.get("batch_index", 0) >= 1:
+            pid = (_read_json(state_path) or {}).get("child_pid")
+            if pid:
+                os.kill(pid, signal.SIGKILL)
+                killed = dict(cur)
+    assert killed is not None, "never saw a mid-epoch cursor save"
+    out, _ = proc.communicate(timeout=420)
+    assert proc.returncode == 0, out[-4000:]
+
+    rec = check_cli_contract_text(out, "train_supervise")
+    assert rec["ok"] is True and rec["restarts"] == 1
+    assert rec["crashes"] == 1 and rec["circuit_open"] is False
+    # Exact mid-epoch resume: the restarted child landed on the cursor...
+    assert f"resumed from epoch {killed['epoch']}, batch " \
+           f"{killed['batch_index']}" in out
+    # ...and every epoch line (including the interrupted epoch 1, whose
+    # train_loss was reassembled from the cursor's loss ledger) matches
+    # the uninterrupted run exactly.
+    got_lines = _epoch_lines(out)
+    assert got_lines == ref_lines
+    # The kill landed mid-epoch, not on a boundary: work was re-executed,
+    # but no more than one --save_every_steps cadence of it.
+    assert killed["batch_index"] < 4
+
+
+def test_supervised_hang_injection_watchdog_kills_and_resumes(
+        tmp_path, train_env):
+    """A training.hang fault (frozen step loop, live heartbeat thread —
+    the wedged-collective simulation) must be detected by PROGRESS
+    staleness, SIGKILLed, and resumed to an honest exit 0 with no human
+    intervention. The restarted child spawns without the fault plan
+    (training/supervisor.py clear_fault_plan_on_restart)."""
+    ckpt = tmp_path / "ckpt"
+    proc = _run(_train_cmd(train_env, ckpt, [
+        "--supervise", "--save_every_steps", "1",
+        "--heartbeat_seconds", "0.2", "--watch_interval_s", "0.1",
+        "--hang_timeout_s", "3", "--start_grace_s", "300",
+        "--train_restart_backoff_s", "0.2",
+        "--num_epochs", "2"]), tmp_path, popen=True,
+        # 6th train batch = epoch 1, batch 2: mid-epoch, after a save.
+        env_extra={"DI_FAULTS": "training.hang=@6"})
+    out, _ = proc.communicate(timeout=420)
+    assert proc.returncode == 0, out[-4000:]
+    rec = check_cli_contract_text(out, "train_supervise")
+    assert rec["ok"] is True
+    assert rec["hang_kills"] == 1 and rec["restarts"] == 1
+    assert "training.hang fault injected" in out
+    assert "wedged" in out  # the watchdog named its verdict
+    assert "resumed from epoch 1" in out
+    # The run finished every epoch after the resume.
+    assert set(_epoch_lines(out)) == {0, 1}

@@ -78,12 +78,28 @@ CONTRACTS = {
     },
     # fsck/v1: python -m deepinteract_tpu.cli.fsck (durable-artifact
     # verify/quarantine/report; robustness/artifacts.py).
+    # stale_heartbeat_hosts + resume_cursor are the ISSUE-14 additions:
+    # which hosts went quiet, and where --resume would land.
     "fsck": {
         "required": ("schema", "metric", "value", "unit", "ok", "root",
                      "scanned", "verified", "unverified", "corrupt",
-                     "quarantined", "tmp_files", "corrupt_paths"),
+                     "quarantined", "tmp_files", "corrupt_paths",
+                     "stale_heartbeats", "stale_heartbeat_hosts",
+                     "resume_cursor"),
         "numeric": ("value", "scanned", "verified", "unverified",
-                    "corrupt", "quarantined", "tmp_files"),
+                    "corrupt", "quarantined", "tmp_files",
+                    "stale_heartbeats"),
+    },
+    # train_supervise/v1: cli/train.py --supervise (training/
+    # supervisor.py TrainingSupervisor.contract): supervised restarts,
+    # hang kills, circuit state, and the honest child exit code.
+    "train_supervise": {
+        "required": ("schema", "metric", "value", "unit", "ok",
+                     "restarts", "hang_kills", "crashes", "spawns",
+                     "circuit_open", "preempted", "child_exit_code",
+                     "state", "state_path", "heartbeat_path"),
+        "numeric": ("value", "restarts", "hang_kills", "crashes",
+                    "spawns"),
     },
 }
 
