@@ -99,6 +99,7 @@ SECTION_EST_S = {
     "b1_p512_tiled": 480,
     "b1_p128_deeplab": 300,
     "screening": 300,
+    "input_pipeline": 420,
     "saturation": 240,
     "rollover": 180,
     "recovery": 240,
@@ -581,7 +582,8 @@ def _section_names(platform: str) -> list:
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
              "b1_p256", "b1_p384_tiled", "eval_path", "screening",
-             "saturation", "rollover", "recovery", "attribution"]
+             "saturation", "rollover", "recovery", "attribution",
+             "input_pipeline"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -1667,6 +1669,80 @@ def _run_recovery_section(ctx, detail) -> None:
     _dump_partial(detail)
 
 
+def _run_input_pipeline_section(ctx, detail) -> None:
+    """Stepped-loader throughput across the loader→step boundary
+    (ISSUE-15): the REAL BucketedLoader feeding the REAL Trainer epoch
+    loop, measured with batch placement inline vs double-buffered on the
+    input pipeline's placement thread (--device_prefetch), under both
+    per-step and scanned dispatch. ``prefetch_overlap_ratio`` (scanned
+    prefetch-on rate / scanned inline rate) is the contract-line figure
+    gated in tools/check_perf_regression.py — unlike the bucket sections
+    (device-resident arguments, zero input pipeline), these rates pay
+    batch assembly + stacking + h2d, so the ratio isolates exactly what
+    moving placement off the dispatch critical path buys."""
+    import jax
+
+    from deepinteract_tpu.data.loader import BucketedLoader, InMemoryDataset
+    from deepinteract_tpu.data.synthetic import random_raw_complex
+    from deepinteract_tpu.training.loop import LoopConfig, Trainer
+    from deepinteract_tpu.training.optim import OptimConfig
+
+    n_complexes = int(os.environ.get("DI_BENCH_IP_COMPLEXES", "16"))
+    batch = int(os.environ.get("DI_BENCH_IP_BATCH", "2"))
+    scan_k = int(os.environ.get("DI_BENCH_IP_SCAN", "4"))
+    epochs = 2  # epoch 1 pays the compiles; epoch 2 is the steady rate
+    rng = np.random.default_rng(5)
+    raws = [random_raw_complex(int(rng.integers(90, 126)),
+                               int(rng.integers(90, 126)), rng)
+            for _ in range(n_complexes)]
+    model = ctx["make_model"]()
+    entry = {"n_complexes": n_complexes, "batch": batch, "scan_k": scan_k}
+    detail["input_pipeline"] = entry
+
+    def stepped_rate(k: int, prefetch: bool) -> float:
+        loader = BucketedLoader(InMemoryDataset(list(raws)),
+                                batch_size=batch, drop_remainder=True)
+        trainer = Trainer(
+            model,
+            LoopConfig(num_epochs=epochs, steps_per_dispatch=k,
+                       log_every=0, device_prefetch=prefetch,
+                       preemption_guard=False, span_log=False),
+            OptimConfig(lr=1e-4,
+                        steps_per_epoch=max(loader.num_batches(), 1),
+                        num_epochs=epochs),
+            log_fn=lambda _m: None,
+        )
+        t0 = time.perf_counter()
+        state = trainer.init_state(next(iter(loader)))
+        _, history = trainer.fit(state, loader)
+        steady_s = history[-1]["epoch_seconds"]  # epoch 1 paid compiles
+        complexes = loader.num_batches() * batch
+        _log(f"input_pipeline: k={k} prefetch={prefetch} "
+             f"steady_epoch={steady_s:.2f}s "
+             f"({complexes / steady_s:.2f} c/s; total "
+             f"{time.perf_counter() - t0:.0f}s incl. compiles)")
+        return complexes / steady_s
+
+    # Scanned dispatch first (the gated ratio), then per-step; inline
+    # before prefetch within each so a deadline kill loses the ratio,
+    # never ships it half-measured.
+    entry["scan_inline_cps"] = stepped_rate(scan_k, False)
+    _dump_partial(detail)
+    entry["scan_prefetch_cps"] = stepped_rate(scan_k, True)
+    entry["prefetch_overlap_ratio"] = (
+        entry["scan_prefetch_cps"] / entry["scan_inline_cps"])
+    _dump_partial(detail)
+    if _child_time_left() > 240:
+        entry["per_step_inline_cps"] = stepped_rate(1, False)
+        entry["per_step_prefetch_cps"] = stepped_rate(1, True)
+        entry["per_step_overlap_ratio"] = (
+            entry["per_step_prefetch_cps"] / entry["per_step_inline_cps"])
+    else:
+        entry["per_step_skipped"] = "section deadline too close"
+    _log(json.dumps({"input_pipeline": entry}))
+    _dump_partial(detail)
+
+
 def _run_attribution_section(ctx, detail) -> None:
     """Device-time attribution of the serving forward (ISSUE-8): capture
     a jax.profiler trace around a few warm predicts, parse it to per-op
@@ -1748,7 +1824,8 @@ def _section_result_key(name: str):
     if name == "eval_path":
         return None, "eval_path_b128"
     if name in ("tuned_ab", "stem_ab", "precision_ab", "screening",
-                "saturation", "rollover", "recovery", "attribution"):
+                "saturation", "rollover", "recovery", "attribution",
+                "input_pipeline"):
         return None, name
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
@@ -1787,6 +1864,8 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_recovery_section(ctx, detail)
     elif name == "attribution":
         _run_attribution_section(ctx, detail)
+    elif name == "input_pipeline":
+        _run_input_pipeline_section(ctx, detail)
     elif name.startswith("ab_p"):
         _run_ab_section(int(name[4:]), ctx, detail)
     else:
@@ -1928,6 +2007,18 @@ def _build_headline(detail, scan_k) -> dict:
             for k in ("mttr_s", "steps_reexecuted", "save_every_steps",
                       "restarts", "supervisor_ok")
             if k in recovery}
+    input_pipeline = detail.get("input_pipeline", {})
+    if "prefetch_overlap_ratio" in input_pipeline:
+        # Input-pipeline contract keys (ISSUE-15): the stepped-loader
+        # rate with placement double-buffered on the prefetch thread vs
+        # inline, under scanned (gated) and per-step dispatch. Gated in
+        # tools/check_perf_regression.py.
+        line["input_pipeline"] = {
+            k: round(input_pipeline[k], 4)
+            for k in ("prefetch_overlap_ratio", "scan_prefetch_cps",
+                      "scan_inline_cps", "per_step_overlap_ratio",
+                      "per_step_prefetch_cps", "per_step_inline_cps")
+            if isinstance(input_pipeline.get(k), (int, float))}
     screening = detail.get("screening", {})
     if "screen_pairs_per_sec" in screening:
         # The bulk-screening workload's own throughput row (ISSUE-6):
@@ -1957,7 +2048,7 @@ def _is_partial(detail) -> bool:
                    if k.startswith(("attention_ab", "eval_path", "tuned_ab",
                                     "stem_ab", "precision_ab", "screening",
                                     "saturation", "rollover", "recovery",
-                                    "attribution"))
+                                    "attribution", "input_pipeline"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates
                if isinstance(c, dict))

@@ -5,6 +5,7 @@ from deepinteract_tpu.analysis.rules import (  # noqa: F401
     dead_cli_flag,
     dtype_discipline,
     jit_host_sync,
+    loader_boundary,
     lock_discipline,
     no_print,
     prng_reuse,

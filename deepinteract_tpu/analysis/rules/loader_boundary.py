@@ -1,0 +1,76 @@
+"""Rule ``loader-boundary``: no bare ``jax.device_put`` inside training/.
+
+Batch placement is a first-class stage of the input pipeline
+(``data/pipeline.py``): it is sharding-aware (mesh batches land
+pre-sharded via the same ``NamedSharding`` constructors the sharded
+steps use for ``in_shardings``), multi-host safe (each host places only
+its local shard), double-buffered under ``--device_prefetch``, and
+telemetered (``di_data_h2d_*``). A bare ``jax.device_put`` on a batch
+pytree inside ``training/`` is exactly how the pre-ISSUE-15 trainer
+reintroduced the single-device-only prefetch limitation — it commits to
+one device, bypasses the mesh sharding, and hides the h2d from the
+pipeline's accounting — so it is flagged at lint time.
+
+Flags calls to ``jax.device_put`` (or a bare ``device_put`` imported
+from jax) AND bare references to it (the historical regression was an
+assignment, ``train_data.device_transfer = jax.device_put`` — no call
+node involved) in any file under ``deepinteract_tpu/training/``.
+Non-batch placements with a reason (e.g. the SWA params placement in
+``training/loop.py``) carry ``# di: allow[loader-boundary] <reason>``.
+The placement layer itself (``data/pipeline.py``) and the mesh helpers
+(``parallel/mesh.py``) are out of scope by construction — they ARE the
+sanctioned boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Sequence
+
+from deepinteract_tpu.analysis.core import Finding, SourceFile, dotted_name, register
+
+RULE = "loader-boundary"
+
+SCOPE_PREFIX = "deepinteract_tpu/training/"
+
+MESSAGE = ("bare jax.device_put in training/ — batch placement belongs to "
+           "the input pipeline's placement layer (data/pipeline.py "
+           "BatchPlacement / parallel/mesh.py shard_batch); annotate why a "
+           "trainer-side placement is not a batch")
+
+
+def in_scope(path: str) -> bool:
+    return path.startswith(SCOPE_PREFIX)
+
+
+@register(RULE, "no bare jax.device_put inside training/ — placement is a "
+                "pipeline stage (data/pipeline.py)")
+def check(files: Sequence[SourceFile]) -> Iterable[Finding]:
+    for f in files:
+        if f.tree is None or not in_scope(f.path):
+            continue
+        # Calls first: jax.device_put(...), any attribute chain ending in
+        # device_put, or a bare ``device_put(...)`` pulled in via
+        # ``from jax import device_put``. The call's func node is marked
+        # consumed so the reference walk below does not double-report it.
+        consumed = set()
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is not None and name[-1] == "device_put":
+                consumed.add(id(node.func))
+                yield Finding(rule=RULE, path=f.path, line=node.lineno,
+                              message=MESSAGE)
+        # Bare references — the historical regression class was an
+        # ASSIGNMENT of the function object (loader hook install), which
+        # has no Call node at all.
+        for node in ast.walk(f.tree):
+            if id(node) in consumed:
+                continue
+            if ((isinstance(node, ast.Attribute)
+                 and node.attr == "device_put")
+                    or (isinstance(node, ast.Name)
+                        and node.id == "device_put")):
+                yield Finding(rule=RULE, path=f.path, line=node.lineno,
+                              message=MESSAGE)

@@ -244,15 +244,18 @@ def add_training_args(p: argparse.ArgumentParser) -> None:
 
     g = p.add_argument_group("input pipeline")
     g.add_argument("--device_prefetch", action="store_true",
-                   help="move jax.device_put of upcoming batches onto the "
-                        "loader's prefetch thread (double-buffered h2d): "
-                        "the transfer overlaps the previous device_step "
-                        "instead of serializing before each dispatch. "
-                        "Single-device, per-step dispatch only — scanned "
-                        "multi-step dispatches stack batches on host "
-                        "(training/loop.py h2d caveat) and mesh runs "
-                        "place via shardings, so it is skipped (with a "
-                        "log line) there")
+                   help="run batch placement double-buffered on the input "
+                        "pipeline's placement thread (data/pipeline.py): "
+                        "the sharding-aware h2d — and the [K, B, ...] "
+                        "scan-stacking when --steps_per_dispatch > 1 — "
+                        "overlaps the previous device dispatch instead of "
+                        "serializing before it. Engages in every dispatch "
+                        "mode (single device, mesh, scanned, and "
+                        "mesh+scanned; mesh batches land pre-sharded, "
+                        "each host placing only its local shard), pinning "
+                        "at most the loader's prefetch depth of "
+                        "dispatches in device memory (a scanned dispatch "
+                        "is a [K, B, ...] stack: prefetch*K batches)")
 
 
 def add_serving_args(p: argparse.ArgumentParser) -> None:

@@ -147,15 +147,16 @@ class BucketedLoader:
         # fetch, then the real epoch) — hosts call _produce in the same
         # order, so the serial stays aligned across the mesh.
         self._agree_serial = 0
-        # Optional h2d hook (--device_prefetch): a callable applied to each
-        # assembled batch ON THE PREFETCH THREAD (``_produce`` runs inside
-        # ``_prefetched``'s worker when prefetch > 0). The Trainer installs
-        # ``jax.device_put`` here so the async transfer overlaps the
-        # consumer's device_step — double-buffered h2d via the queue depth.
-        # The Trainer only installs it for single-device, per-step-dispatch
-        # runs: scanned multi-step dispatches must keep batches on host
-        # (they np.stack K batches into one placement — training/loop.py
-        # h2d caveat) and mesh runs place via shardings.
+        # Optional per-batch hook: a callable applied to each assembled
+        # batch ON THE PREFETCH THREAD (``_produce`` runs inside
+        # ``_prefetched``'s worker when prefetch > 0) — e.g. a placement
+        # fn so a transfer overlaps the consumer's device compute.
+        # The Trainer no longer installs anything here: its
+        # --device_prefetch placement (sharding-aware, scan-stack-aware,
+        # all four dispatch modes) rides the data/pipeline.py placement
+        # stage DOWNSTREAM of this queue instead, where same-shape runs
+        # can be grouped before the h2d. The hook stays for external
+        # consumers that want batches transformed at assembly time.
         self.device_transfer = None
         self._bucket_fn = None  # built once on first _item_bucket call
         # Bucket planning reads every header once, up front.

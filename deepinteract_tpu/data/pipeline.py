@@ -1,0 +1,299 @@
+"""Mesh-aware batch placement: the loader→step boundary as a pipeline stage.
+
+Before this layer, batch *placement* was a trainer-side afterthought: the
+``--device_prefetch`` hook issued a bare ``jax.device_put`` on the
+loader's prefetch thread, and skipped itself whenever a mesh was active
+or ``steps_per_dispatch > 1`` — exactly the scanned/sharded regime where
+sustained rate matters (ROADMAP item 4; tools/sustained_train.py measured
+~51% of the micro-bench scan rate with the h2d + scan-stacking on the
+dispatch critical path). This module makes placement a first-class,
+pluggable stage of the input pipeline:
+
+* :class:`BatchPlacement` — the sharding-aware placement function for one
+  (mesh, steps_per_dispatch) configuration. Single-device batches are
+  ``jax.device_put``; mesh batches land PRE-SHARDED via per-leaf
+  ``NamedSharding`` built from the same ``parallel/mesh.py`` constructors
+  the sharded step functions use for ``in_shardings`` (multi-host safe:
+  each host places only its local shard through
+  ``make_array_from_process_local_data``).
+* Scan-stacking for ``steps_per_dispatch > 1`` happens HERE — the
+  ``np.stack`` of K batches plus the h2d of the [K, B, ...] stack runs on
+  the placement thread, off the dispatch critical path (the FlashAttention
+  discipline one level up: keep the device fed so the kernels stay the
+  bottleneck).
+* :func:`placed_runs` — the double-buffered background stage: placements
+  run on a daemon thread with a semaphore bound, so at most ``depth``
+  dispatches of device memory are ever pinned ahead of the consumer.
+
+Telemetry: every placement records wall seconds and payload bytes in the
+``di_data_h2d_seconds_total`` / ``di_data_h2d_bytes_total`` counters (and
+returns them on the :class:`PlacedRun` so the Trainer's ``tele_h2d``
+decomposition reflects the overlapped reality). Chaos: the ``data.place``
+fault site raises (surfaced as a typed :class:`PlacementError`, never a
+hang) and ``data.place_hang`` freezes the placement thread — the
+wedged-input-pipeline simulation the PR-14 supervisor watchdog SIGKILLs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, List, NamedTuple, Optional
+
+import numpy as np
+
+from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.robustness import faults
+
+_H2D_SECONDS = obs_metrics.counter(
+    "di_data_h2d_seconds_total",
+    "Wall seconds spent placing train batches on device by the input "
+    "pipeline's placement layer (overlaps device compute when "
+    "--device_prefetch is on)")
+_H2D_BYTES = obs_metrics.counter(
+    "di_data_h2d_bytes_total",
+    "Host bytes handed to device placement by the input pipeline's "
+    "placement layer")
+_PLACED_DISPATCHES = obs_metrics.counter(
+    "di_data_placed_dispatches_total",
+    "Dispatch payloads (single batches or [K, B, ...] scan-stacks) "
+    "placed by the input pipeline's placement layer",
+    labelnames=("mode",))
+
+
+class PlacementError(RuntimeError):
+    """Typed failure of the batch-placement stage.
+
+    Raised on the CONSUMER side (the trainer's dispatch loop) even when
+    the placement itself ran on the background thread — a placement
+    fault must surface as an exception at the next dispatch boundary,
+    never as a silently wedged queue."""
+
+
+class PlacedRun(NamedTuple):
+    """One same-shape run of host batches plus its placed dispatch form.
+
+    ``kind`` selects how the trainer dispatches it:
+
+    * ``"per_batch"`` — ``placed`` is a list aligned with ``host``; each
+      entry is one single-batch dispatch (runs shorter than the scan
+      width, or ``steps_per_dispatch == 1``).
+    * ``"packed"``    — ``placed`` is ``(buffers, spec)`` from
+      ``training.steps.pack_tree`` over the [K, B, ...] stack (single
+      device; one buffer per dtype, O(dtypes) transfers).
+    * ``"stacked"``   — ``placed`` is the [K, B, ...] pytree sharded over
+      the mesh (scan axis unsharded, batch axis over ``data``).
+
+    ``h2d_s`` aligns with ``placed`` for ``per_batch`` (one float per
+    batch) and holds a single float otherwise. Byte accounting lives in
+    the ``di_data_h2d_bytes_total`` counter (recorded at placement time),
+    not here."""
+
+    host: List[Any]
+    kind: str
+    placed: Any
+    h2d_s: tuple
+
+
+def is_placed(tree) -> bool:
+    """True when the pytree's array leaves are already device-committed
+    ``jax.Array``s (placement must not run twice)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return bool(leaves) and isinstance(leaves[0], jax.Array)
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+
+    return int(sum(np.asarray(l).nbytes
+                   for l in jax.tree_util.tree_leaves(tree)))
+
+
+def _chaos_probe(mode: str) -> None:
+    """The placement-stage fault sites (robustness/faults.py): a raise
+    must surface to the trainer as a typed error; a hang freezes THIS
+    thread (the placement thread under ``placed_runs``) while the
+    heartbeat daemon keeps beating — exactly the stale-progress
+    signature the training supervisor watchdog SIGKILLs."""
+    faults.maybe_raise(
+        "data.place",
+        lambda: PlacementError(
+            f"injected data.place fault (placement mode {mode})"))
+    if faults.fire("data.place_hang"):
+        import logging
+
+        logging.getLogger(__name__).error(
+            "data.place_hang fault injected: placement frozen until "
+            "SIGKILL (watchdog bait)")
+        while True:
+            time.sleep(0.25)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchPlacement:
+    """The placement function for one dispatch configuration.
+
+    ``transfer=False`` is the inline (no-prefetch) configuration: it
+    performs exactly the host-side preparation the dispatch path always
+    did (mesh batches are sharded — mandatory — while single-device
+    batches stay host-resident for jit to place at dispatch), so the
+    non-prefetch path is bit-for-bit the historical one. ``transfer=True``
+    additionally issues the h2d eagerly so it can run off the critical
+    path."""
+
+    mesh: Any = None
+    steps_per_dispatch: int = 1
+    transfer: bool = True
+
+    @property
+    def mode(self) -> str:
+        """``single``/``mesh`` × ``per-step``/``scanned`` — the four
+        dispatch modes prefetch now engages in (the fit-start log line
+        and the ``di_data_placed_dispatches_total`` label)."""
+        return ("mesh" if self.mesh is not None else "single") + "/" + (
+            "scanned" if self.steps_per_dispatch > 1 else "per-step")
+
+    # -- primitives --------------------------------------------------------
+
+    def place_batch(self, batch):
+        """Place one [B, ...] batch for a single-step dispatch."""
+        _chaos_probe(self.mode)
+        if self.mesh is None and is_placed(batch):
+            # Already committed (external device_transfer hook) and no
+            # mesh to satisfy: idempotent passthrough. Mesh batches fall
+            # through regardless — a hook-committed single-device array
+            # must still be resharded to the step's in_shardings.
+            return batch
+        try:
+            if self.mesh is not None:
+                from deepinteract_tpu.parallel.mesh import shard_batch
+
+                return self._timed(batch, lambda: shard_batch(batch, self.mesh))
+            if not self.transfer:
+                return batch  # jit places at dispatch (historical path)
+            import jax
+
+            return self._timed(batch, lambda: jax.device_put(batch))
+        except PlacementError:
+            raise
+        except Exception as exc:
+            raise PlacementError(
+                f"batch placement failed (mode {self.mode}): {exc}"
+            ) from exc
+
+    def place_stacked(self, run: List[Any]):
+        """Stack a full same-shape run into its one-dispatch form and
+        place it: mesh → [K, B, ...] sharded over ``data`` (scan axis
+        unsharded); single device → the packed upload (one buffer per
+        dtype, ``training.steps.pack_tree``), device-placed when
+        ``transfer``. Returns the ``PlacedRun.placed`` payload."""
+        _chaos_probe(self.mode)
+        from deepinteract_tpu.training.steps import (
+            pack_tree,
+            stack_microbatches,
+        )
+
+        try:
+            stacked = stack_microbatches(run)
+            if self.mesh is not None:
+                from deepinteract_tpu.parallel.mesh import shard_stacked_batch
+
+                return self._timed(
+                    stacked, lambda: shard_stacked_batch(stacked, self.mesh))
+            buffers, spec = pack_tree(stacked)
+            if not self.transfer:
+                return buffers, spec  # jit places at dispatch
+            import jax
+
+            return self._timed(buffers, lambda: jax.device_put(buffers)), spec
+        except PlacementError:
+            raise
+        except Exception as exc:
+            raise PlacementError(
+                f"scan-stack placement failed (mode {self.mode}): {exc}"
+            ) from exc
+
+    def _timed(self, host_payload, place_fn):
+        t0 = time.perf_counter()
+        placed = place_fn()
+        _H2D_SECONDS.inc(time.perf_counter() - t0)
+        _H2D_BYTES.inc(_tree_nbytes(host_payload))
+        _PLACED_DISPATCHES.inc(mode=self.mode)
+        return placed
+
+    # -- the run-level stage -----------------------------------------------
+
+    def place_run(self, run: List[Any]) -> PlacedRun:
+        """One same-shape run → its :class:`PlacedRun`, dispatch-shape
+        aware (mirrors the trainer's run handling: runs shorter than the
+        scan width dispatch per batch)."""
+        k = max(1, self.steps_per_dispatch)
+        if len(run) < max(k, 2):
+            placed, times = [], []
+            for b in run:
+                t0 = time.perf_counter()
+                placed.append(self.place_batch(b))
+                times.append(time.perf_counter() - t0)
+            return PlacedRun(host=run, kind="per_batch", placed=placed,
+                             h2d_s=tuple(times))
+        t0 = time.perf_counter()
+        placed = self.place_stacked(run)
+        dur = time.perf_counter() - t0
+        kind = "stacked" if self.mesh is not None else "packed"
+        return PlacedRun(host=run, kind=kind, placed=placed,
+                         h2d_s=(dur,))
+
+
+def placed_runs(runs, placement: BatchPlacement, depth: int):
+    """Double-buffered placement stage: consume same-shape runs from
+    ``runs`` on a daemon thread, place each via ``placement.place_run``,
+    and yield :class:`PlacedRun`s to the dispatch loop.
+
+    Memory bound: a semaphore slot is reserved BEFORE each placement and
+    released only when the consumer asks for the NEXT item, so at most
+    ``depth`` placed dispatches of device memory are pinned by the stage
+    (including the one currently being dispatched). Exceptions — from the
+    source iterator or the placement itself — propagate to the consumer
+    at its next pull; abandoning the generator (break / GeneratorExit)
+    stops the worker instead of leaving it blocked with pinned batches.
+    """
+    depth = max(1, int(depth))
+    sem = threading.Semaphore(depth)
+    q: "queue.Queue" = queue.Queue()
+    done = object()
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for run in runs:
+                while not sem.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                q.put(placement.place_run(run))
+        except BaseException as exc:  # noqa: BLE001 - re-raised consumer-side
+            q.put((done, exc))
+            return
+        q.put((done, None))
+
+    t = threading.Thread(target=worker, daemon=True, name="di-placement")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is done:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+            # Released only once the consumer came back for more: the
+            # just-yielded dispatch still counts against the pin bound
+            # while it is in flight.
+            sem.release()
+    finally:
+        stop.set()

@@ -118,6 +118,30 @@ def random_complex(
     )
 
 
+def random_raw_complex(n1: int, n2: int, rng: np.random.Generator,
+                       knn: int = constants.KNN,
+                       geo_nbrhd_size: int = constants.GEO_NBRHD_SIZE,
+                       contact_cutoff: float = 8.0) -> dict:
+    """Un-padded raw complex dict (``{"graph1", "graph2", "examples"}``)
+    in the dataset-protocol shape ``data/loader.InMemoryDataset``
+    consumes — the loader-facing twin of :func:`random_complex`, for
+    input-pipeline benchmarks/tests whose batches must flow through the
+    REAL loader path (bucketing, padding, prefetch, placement)."""
+    raws, cas = [], []
+    for n, origin in ((n1, np.zeros(3)), (n2, np.array([10.0, 0.0, 0.0]))):
+        bb = random_backbone(n, rng, origin=origin)
+        raws.append(F.featurize_chain(
+            bb, random_residue_feats(n, rng), knn=knn,
+            geo_nbrhd_size=geo_nbrhd_size, rng=rng))
+        cas.append(bb[:, 1, :])
+    d = np.linalg.norm(cas[0][:, None] - cas[1][None, :], axis=-1)
+    contact = (d < contact_cutoff).astype(np.int32)
+    ii, jj = np.meshgrid(np.arange(n1), np.arange(n2), indexing="ij")
+    examples = np.stack([ii.ravel(), jj.ravel(), contact.ravel()],
+                        axis=1).astype(np.int32)
+    return {"graph1": raws[0], "graph2": raws[1], "examples": examples}
+
+
 def write_tiny_npz_dataset(root: str, n_complexes: int = 5,
                            n1: int = 24, n2: int = 21, seed: int = 0,
                            knn: int = 6, geo_nbrhd_size: int = 2) -> None:
@@ -138,23 +162,12 @@ def write_tiny_npz_dataset(root: str, n_complexes: int = 5,
     rng = np.random.default_rng(seed)
     names = []
     for i in range(n_complexes):
-        raws = []
-        cas = []
-        for n, origin in ((n1, np.zeros(3)),
-                          (n2, np.array([10.0, 0.0, 0.0]))):
-            bb = random_backbone(n, rng, origin=origin)
-            raws.append(F.featurize_chain(
-                bb, random_residue_feats(n, rng), knn=knn,
-                geo_nbrhd_size=geo_nbrhd_size, rng=rng))
-            cas.append(bb[:, 1, :])
-        d = np.linalg.norm(cas[0][:, None] - cas[1][None, :], axis=-1)
-        contact = (d < 8.0).astype(np.int32)
-        ii, jj = np.meshgrid(np.arange(n1), np.arange(n2), indexing="ij")
-        examples = np.stack([ii.ravel(), jj.ravel(), contact.ravel()],
-                            axis=1).astype(np.int32)
+        raw = random_raw_complex(n1, n2, rng, knn=knn,
+                                 geo_nbrhd_size=geo_nbrhd_size)
         name = f"c{i}.npz"
-        save_complex_npz(os.path.join(processed, name), raws[0], raws[1],
-                         examples, complex_name=f"c{i}")
+        save_complex_npz(os.path.join(processed, name), raw["graph1"],
+                         raw["graph2"], raw["examples"],
+                         complex_name=f"c{i}")
         names.append(name)
     for mode, sel in (("train", names), ("val", names[:1]),
                       ("test", names[:1])):

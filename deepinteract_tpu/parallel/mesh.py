@@ -55,14 +55,31 @@ def make_mesh(
     return Mesh(arr, (DATA_AXIS, PAIR_AXIS))
 
 
-def _place(tree, mesh: Mesh, spec: P, replicated: bool = False):
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """The per-step batch sharding ([B, ...] split over ``data``) — the
+    ONE definition shared by batch placement (:func:`shard_batch`, the
+    ``data/pipeline.py`` placement layer) and the sharded step functions'
+    ``in_shardings`` (``parallel/train.py``), so a pre-placed batch can
+    never disagree with what the step expects (no silent reshard)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[K, B, ...] scan-stack sharding: scan axis unsharded, batch axis
+    split over ``data``. Same single-source-of-truth contract as
+    :func:`batch_sharding`."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def _place(tree, mesh: Mesh, spec: P, replicated: bool = False,
+           sharding: Optional[NamedSharding] = None):
     """Place a pytree with one sharding spec.
 
     Single-process: plain sharded ``device_put``. Multi-process (mesh
     spans hosts): each host contributes its *local* arrays as its shard of
     the global array (``jax.make_array_from_process_local_data``); for
     fully-replicated specs the global shape equals the local shape."""
-    sharding = NamedSharding(mesh, spec)
+    sharding = sharding if sharding is not None else NamedSharding(mesh, spec)
     if jax.process_count() > 1:
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(
@@ -77,14 +94,16 @@ def shard_batch(batch, mesh: Mesh):
     """Place a stacked batch pytree with its leading axis split over
     ``data``. Multi-process: the global batch is the concatenation of the
     hosts' local batches, so a per-host batch of B complexes trains a
-    global batch of ``B * process_count`` exactly like DDP."""
-    return _place(batch, mesh, P(DATA_AXIS))
+    global batch of ``B * process_count`` exactly like DDP — each host
+    contributes (and transfers) only its LOCAL shard."""
+    return _place(batch, mesh, P(DATA_AXIS), sharding=batch_sharding(mesh))
 
 
 def shard_stacked_batch(stacked, mesh: Mesh):
     """Like :func:`shard_batch` for [K, B, ...] scan-stacked batches: the
     scan axis stays unsharded, the batch axis splits over ``data``."""
-    return _place(stacked, mesh, P(None, DATA_AXIS))
+    return _place(stacked, mesh, P(None, DATA_AXIS),
+                  sharding=stacked_batch_sharding(mesh))
 
 
 def replicate(tree, mesh: Mesh):

@@ -14,7 +14,10 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepinteract_tpu.parallel.mesh import DATA_AXIS, PAIR_AXIS
+from deepinteract_tpu.parallel.mesh import (
+    batch_sharding,
+    stacked_batch_sharding,
+)
 from deepinteract_tpu.training.steps import TrainState, train_step
 
 
@@ -28,9 +31,16 @@ def make_sharded_train_step(mesh: Mesh, weight_classes: bool = False, donate: bo
     Under GSPMD the guarded ``lax.cond`` branches on the globally-reduced
     loss/grad-norm — replicated values, so every device and host takes the
     same branch; no extra collective is needed for agreement.
+
+    Input contract: the batch's ``in_shardings`` comes from
+    ``mesh.batch_sharding`` — the SAME constructor the placement layer
+    (``data/pipeline.py``) uses — so a batch pre-placed on the loader's
+    prefetch thread arrives with a matching sharding and is consumed
+    as-is (no re-placement, no resharding copy); host numpy batches are
+    placed by jit at dispatch exactly as before.
     """
     replicated = NamedSharding(mesh, P())
-    batch_sharded = NamedSharding(mesh, P(DATA_AXIS))
+    batch_sharded = batch_sharding(mesh)
 
     step = partial(train_step, weight_classes=weight_classes, axis_name=None,
                    guard=guard)
@@ -51,7 +61,7 @@ def make_sharded_multi_step(mesh: Mesh, weight_classes: bool = False, donate: bo
     from deepinteract_tpu.training.steps import multi_train_step
 
     replicated = NamedSharding(mesh, P())
-    batch_sharded = NamedSharding(mesh, P(None, DATA_AXIS))
+    batch_sharded = stacked_batch_sharding(mesh)
 
     step = partial(multi_train_step, weight_classes=weight_classes, axis_name=None,
                    guard=guard)
@@ -67,7 +77,7 @@ def make_sharded_eval_step(mesh: Mesh, weight_classes: bool = False):
     from deepinteract_tpu.training.steps import eval_step
 
     replicated = NamedSharding(mesh, P())
-    batch_sharded = NamedSharding(mesh, P(DATA_AXIS))
+    batch_sharded = batch_sharding(mesh)
     step = partial(eval_step, weight_classes=weight_classes)
     return jax.jit(
         step,
@@ -82,7 +92,7 @@ def make_sharded_multi_eval_step(mesh: Mesh, weight_classes: bool = False):
     from deepinteract_tpu.training.steps import multi_eval_step
 
     replicated = NamedSharding(mesh, P())
-    batch_sharded = NamedSharding(mesh, P(None, DATA_AXIS))
+    batch_sharded = stacked_batch_sharding(mesh)
     step = partial(multi_eval_step, weight_classes=weight_classes)
     return jax.jit(
         step,

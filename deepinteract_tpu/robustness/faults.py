@@ -55,6 +55,14 @@ Registered sites:
 * ``training.hang``       — freezes the step loop forever at that train
   batch while the heartbeat thread keeps beating (the wedged-collective
   simulation; only the supervisor watchdog's SIGKILL ends it)
+* ``data.place``          — raises at the input pipeline's batch
+  placement (data/pipeline.py); the trainer must surface it as a typed
+  ``PlacementError`` — even when placement ran on the prefetch thread —
+  never hang on a dead queue
+* ``data.place_hang``     — freezes batch placement forever (on the
+  placement thread under --device_prefetch): the wedged-input-pipeline
+  simulation whose stale-progress heartbeat signature the training
+  supervisor watchdog SIGKILLs
 
 When no plan is configured every probe is a dict lookup on an empty map —
 effectively free on hot paths.

@@ -171,14 +171,19 @@ class LoopConfig:
     profile_dir: Optional[str] = None
     profile_steps: int = 3
     # -- input pipeline ----------------------------------------------------
-    # Issue jax.device_put of upcoming train batches on the loader's
-    # prefetch thread (data/loader.py device_transfer hook) so tele_h2d
-    # overlaps device_step instead of serializing before each dispatch.
-    # Engages only for single-device runs with steps_per_dispatch == 1:
-    # scanned dispatches np.stack K host batches into ONE placement
-    # (device arrays there would force K d2h round trips — see the h2d
-    # caveat in _run_train_epoch) and mesh runs place via shardings.
-    # Skipped-with-a-log-line otherwise. Off by default.
+    # Run batch placement double-buffered on the input pipeline's
+    # placement thread (data/pipeline.py): the sharding-aware h2d — and,
+    # for steps_per_dispatch > 1, the np.stack + pack of the [K, B, ...]
+    # scan-stack — overlaps the previous dispatch's device_step instead
+    # of serializing before each dispatch. Engages in ALL four dispatch
+    # modes (single/mesh × per-step/scanned): mesh batches land
+    # pre-sharded via the same NamedSharding constructors the sharded
+    # steps use for in_shardings (multi-host: each host places only its
+    # local shard), and at most the loader's `prefetch` depth of
+    # dispatches is pinned in device memory. Numerically a no-op
+    # (parity-tested bit-equal against the inline path); tele_h2d then
+    # counts overlapped placement-thread seconds, tele_data_wait the
+    # residual critical-path stall. Off by default.
     device_prefetch: bool = False
     # -- autotuning (tuning/) ---------------------------------------------
     # With autotune on and a store path set, the Trainer resolves the
@@ -327,6 +332,13 @@ class Trainer:
         # .py); _run_train_epoch and evaluate poll it at dispatch
         # boundaries. None outside fit or when preemption_guard is off.
         self._preempt: Optional[PreemptionGuard] = None
+        # Input-pipeline placement stage (data/pipeline.py), configured
+        # per-fit by _install_device_prefetch: the inline placement, the
+        # transfer-eager one for the prefetch thread, and the bound on
+        # pinned dispatches (0 = prefetch off, placement inline).
+        self._placement = None
+        self._prefetch_placement = None
+        self._prefetch_depth = 0
         guard = loop_cfg.nonfinite_guard
         from deepinteract_tpu.training.steps import multi_eval_step, multi_train_step
 
@@ -1015,6 +1027,7 @@ class Trainer:
 
                 state = state.replace(params=replicate(swa_params, self.mesh))
             else:
+                # di: allow[loader-boundary] params tree, not a batch: single-device SWA weights need a plain placement, and the stats refresh below re-jits anyway
                 state = state.replace(params=jax.device_put(swa_params))
             # Batch-norm statistics were accumulated for the last-epoch
             # weights; refresh them for the averaged weights (Lightning's
@@ -1032,36 +1045,60 @@ class Trainer:
     # -- internals ---------------------------------------------------------
 
     def _install_device_prefetch(self, train_data: DataSource) -> None:
-        """Wire LoopConfig.device_prefetch into the loader's
-        ``device_transfer`` hook (data/loader.py): upcoming batches get
-        their ``jax.device_put`` issued on the prefetch thread, so the
-        h2d transfer overlaps the previous dispatch's device_step.
+        """Configure the input pipeline's placement stage
+        (data/pipeline.py) for this fit and log the adopted mode once.
 
-        Only engages where it is correct AND useful — single device
-        (mesh runs place via shardings; a bare device_put would commit to
-        one device) with per-step dispatch (the scanned path np.stacks K
-        host batches into one placement; device-resident batches there
-        would pay K d2h round trips — the h2d caveat in
-        _run_train_epoch). Anything else logs the skip reason."""
-        if not self.cfg.device_prefetch:
-            return
-        if not hasattr(train_data, "device_transfer"):
-            self.log("device_prefetch: train data source has no "
-                     "device_transfer hook (not a BucketedLoader); skipped")
-            return
-        if self.mesh is not None:
-            self.log("device_prefetch skipped: mesh runs place batches "
-                     "via shardings (a bare device_put would commit to "
-                     "one device)")
-            return
-        if self.cfg.steps_per_dispatch > 1:
-            self.log("device_prefetch skipped: steps_per_dispatch > 1 "
-                     "stacks batches on host for the scanned dispatch "
-                     "(device-resident batches would round-trip d2h)")
-            return
-        train_data.device_transfer = jax.device_put
-        self.log("device_prefetch: h2d of upcoming batches issued on the "
-                 "loader's prefetch thread (double-buffered)")
+        Placement is a first-class pipeline stage in every dispatch mode
+        (single/mesh × per-step/scanned). Without device_prefetch it
+        runs inline at the dispatch site — bit-for-bit the historical
+        path. With device_prefetch it runs double-buffered on the
+        placement thread: sharding-aware h2d (per-leaf NamedSharding
+        from the trainer's mesh, so batches land pre-sharded and each
+        host places only its local shard) plus the [K, B, ...]
+        scan-stacking for scanned dispatch, bounded to at most the
+        loader's ``prefetch`` depth of pinned dispatches."""
+        from deepinteract_tpu.data.pipeline import BatchPlacement
+
+        k = max(1, self.cfg.steps_per_dispatch)
+        self._placement = BatchPlacement(
+            mesh=self.mesh, steps_per_dispatch=k, transfer=False)
+        depth = 0
+        if self.cfg.device_prefetch:
+            # The pin bound IS the source's read-ahead depth, but in
+            # DISPATCHES: under scanned dispatch each pinned payload is
+            # a [K, B, ...] stack, so the working set is up to
+            # prefetch*K batches (documented in README/--help; lower the
+            # loader's prefetch on memory-tight configs). A loader with
+            # prefetch=0 disabled buffering deliberately (memory cap),
+            # so placement must stay inline there — fabricating a depth
+            # would pin device memory the operator said not to.
+            # Sources without a read-ahead knob (plain sequences) get
+            # the classic double buffer.
+            depth_attr = getattr(train_data, "prefetch", None)
+            depth = 2 if depth_attr is None else max(0, int(depth_attr))
+            if depth == 0:
+                self.log(
+                    "device_prefetch requested but the data source's "
+                    "prefetch depth is 0 (read-ahead disabled) — "
+                    "placement stays inline; raise the loader's "
+                    "prefetch to enable double-buffering")
+            else:
+                self._prefetch_placement = BatchPlacement(
+                    mesh=self.mesh, steps_per_dispatch=k, transfer=True)
+        self._prefetch_depth = depth
+        if depth:
+            extra = (" (multi-host: each host places its local shard)"
+                     if self.mesh is not None and jax.process_count() > 1
+                     else "")
+            self.log(
+                f"input pipeline: placement mode {self._placement.mode}, "
+                f"double-buffered on the placement thread (depth {depth})"
+                f"{extra}")
+        else:
+            why = ("source prefetch depth 0" if self.cfg.device_prefetch
+                   else "device_prefetch off")
+            self.log(f"input pipeline: placement mode "
+                     f"{self._placement.mode}, inline ({why})")
 
     @staticmethod
     def _epoch_telemetry(epoch_stats: Dict[str, float], ckpt_s: float,
@@ -1074,6 +1111,10 @@ class Trainer:
         data_s = float(epoch_stats.get("data_wait_s", 0.0))
         h2d_s = float(epoch_stats.get("h2d_s", 0.0))
         device_s = float(epoch_stats.get("device_s", 0.0))
+        # h2d semantics under --device_prefetch: placement ran on the
+        # pipeline's placement thread, so tele_h2d counts OVERLAPPED
+        # seconds (it can legitimately exceed the critical-path share);
+        # the residual input stall is tele_data_wait.
         return {
             "tele_data_wait_s": data_s,
             "tele_h2d_s": h2d_s,
@@ -1081,6 +1122,7 @@ class Trainer:
             "tele_checkpoint_s": float(ckpt_s),
             "tele_eval_s": float(eval_s),
             "tele_data_wait_frac": data_s / wall,
+            "tele_h2d_frac": h2d_s / wall,
             "tele_device_frac": device_s / wall,
             "tele_checkpoint_frac": float(ckpt_s) / wall,
             "tele_eval_frac": float(eval_s) / wall,
@@ -1228,8 +1270,6 @@ class Trainer:
           the abort lands up to one dispatch late — acceptable, since the
           guard already prevented every bad update on device.
         """
-        from deepinteract_tpu.training.steps import stack_microbatches
-
         cfg = self.cfg
         k = max(1, cfg.steps_per_dispatch)
         # Mid-epoch resume: numbering continues from the cursor so logs,
@@ -1399,69 +1439,104 @@ class Trainer:
                 next(src, None)
             return src
 
-        # data_wait: host wall time blocked pulling the next same-shape run
-        # out of the (possibly prefetching) loader — the input-bound-loop
-        # detector. Measured around the iterator's next() because the wait
-        # happens inside generator suspension where a `with` cannot reach;
-        # each wait is also emitted as a leaf span event.
-        run_iter = iter(_shape_runs(instrumented(epoch_source()), k))
+        # The loader→step boundary (data/pipeline.py): same-shape runs go
+        # through the BatchPlacement stage. With device_prefetch the
+        # placement — sharding-aware h2d plus the [K, B, ...]
+        # scan-stacking for scanned dispatch — runs double-buffered on
+        # the placement thread, bounded to at most `prefetch` pinned
+        # dispatches; without it the IDENTICAL placement runs inline at
+        # the dispatch site (the historical path, bit-for-bit).
+        #
+        # data_wait: host wall time blocked pulling the next same-shape
+        # (possibly pre-placed) run — the input-bound-loop detector.
+        # Measured around the iterator's next() because the wait happens
+        # inside generator suspension where a `with` cannot reach; each
+        # wait is also emitted as a leaf span event. h2d counts placement
+        # seconds wherever they ran: on the placement thread they overlap
+        # device compute and the critical-path stall shows up (only) in
+        # data_wait.
+        if self._placement is None:  # _run_train_epoch outside fit (tests)
+            self._install_device_prefetch(train_data)
+        placement = self._placement
+        overlap = self._prefetch_depth > 0
+        source = _shape_runs(instrumented(epoch_source()), k)
+        if overlap:
+            from deepinteract_tpu.data.pipeline import placed_runs
+
+            run_iter = iter(placed_runs(source, self._prefetch_placement,
+                                        self._prefetch_depth))
+        else:
+            run_iter = iter(source)
         while True:
             t_wait = time.perf_counter()
-            run = next(run_iter, None)
+            item = next(run_iter, None)
             waited = time.perf_counter() - t_wait
             stats["data_wait_s"] += waited
-            if run is None:
+            if item is None:
                 break
+            pr = item if overlap else None  # PlacedRun | host run list
+            run = pr.host if pr is not None else item
             obs_spans.emit("data_wait", waited, n=len(run))
             self._check_preempt()
             recent_runs.append(run)
-            if len(run) < max(k, 2):
+            # The per-batch-vs-stacked decision belongs to the placement
+            # layer: a PlacedRun says which form it holds (pr.kind); only
+            # the inline path derives it locally, with the same rule
+            # place_run applies.
+            per_batch = (pr.kind == "per_batch" if pr is not None
+                         else len(run) < max(k, 2))
+            if per_batch:
                 if pending is not None:
                     flush(pending)
                     pending = None
-                for b in run:
+                for j, hb in enumerate(run):
                     # Each batch here is its OWN device dispatch, so the
                     # profile window and step numbering advance per batch
                     # (the scanned branch advances once per scan).
                     self._profile_tick()
                     with obs_spans.span("step",
                                         step_num=self._dispatch_count):
-                        with obs_spans.span("h2d") as h2d_span:
-                            batch = self._device_batch(b)
+                        if pr is not None:
+                            batch = pr.placed[j]
+                            h2d_s = pr.h2d_s[j]
+                            obs_spans.emit("h2d", h2d_s)
+                        else:
+                            with obs_spans.span("h2d") as h2d_span:
+                                batch = placement.place_batch(hb)
+                            h2d_s = h2d_span.dur_s
                         with obs_spans.span("device_step") as dev_span:
                             state, metrics = self._train_step(state, batch)
                             log_step(metrics)
-                    stats["h2d_s"] += h2d_span.dur_s
+                    stats["h2d_s"] += h2d_s
                     stats["device_s"] += dev_span.dur_s
                     self._dispatch_count += 1
                     dispatched += 1
                     since_save += 1
                     maybe_midsave(state)
             else:
-                # Buffered batches stay on host until stacked here; ONE
-                # placement per dispatch (device_put-ing each batch first
-                # would force K device->host->device round-trips through
-                # np.stack). Multi-host needs the explicit global-array
-                # construction in _device_stacked; single-device runs
-                # take the packed upload (one buffer per dtype).
+                # ONE placement per dispatch: the full run stacks to
+                # [K, B, ...] — mesh runs land pre-sharded (multi-host:
+                # global arrays from this host's local slice), single
+                # device takes the packed upload (one buffer per dtype).
                 self._profile_tick()
                 with obs_spans.span("step", step_num=self._dispatch_count,
                                     n=len(run)):
-                    with obs_spans.span("h2d") as h2d_span:
-                        if self.mesh is None:
-                            from deepinteract_tpu.training.steps import pack_tree
-
-                            buffers, spec = pack_tree(stack_microbatches(run))
-                        else:
-                            placed = self._device_stacked(
-                                stack_microbatches(run))
+                    if pr is not None:
+                        placed = pr.placed
+                        h2d_s = pr.h2d_s[0]
+                        obs_spans.emit("h2d", h2d_s, n=len(run))
+                    else:
+                        with obs_spans.span("h2d") as h2d_span:
+                            placed = placement.place_stacked(run)
+                        h2d_s = h2d_span.dur_s
                     with obs_spans.span("device_step") as dev_span:
                         if self.mesh is None:
+                            buffers, spec = placed
                             state, stacked = self._multi_step_packed(
                                 state, buffers, spec)
                         else:
                             state, stacked = self._multi_step(state, placed)
-                stats["h2d_s"] += h2d_span.dur_s
+                stats["h2d_s"] += h2d_s
                 stats["device_s"] += dev_span.dur_s
                 if pending is not None:
                     flush(pending)  # N-1's fetch, after N's async dispatch

@@ -73,7 +73,7 @@ def test_registered_kinds_cover_every_contract_cli():
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
             "perf_regression", "lint", "fsck", "fleet",
-            "train_supervise"} <= set(CONTRACTS)
+            "train_supervise", "sustained"} <= set(CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
 
@@ -201,6 +201,54 @@ def test_fleet_kind_matches_real_router_emission(tmp_path, capsys):
     # cleanly (workers = still-supervised count), nothing crashed.
     assert rec["ok"] is True and rec["workers"] == 0
     assert rec["restarts"] == 0 and rec["rollovers"] == 0
+
+
+def test_sustained_kind_matches_real_contract_builder():
+    """The sustained/v1 contract is validated against the REAL
+    tools/sustained_train.py builder (same discipline as the bench
+    headline test — the full tool runs a multi-epoch cli.train and is
+    far beyond tier-1 budget, but the record every run prints last comes
+    from this one function)."""
+    from tools.sustained_train import build_contract
+
+    result = {
+        "sustained_complexes_per_sec": 13.7,
+        "scan_complexes_per_sec": 26.9,
+        "ratio_vs_scan": 13.7 / 26.9,
+        "epochs": 3, "n_train_complexes": 48, "steady_epoch_s": 3.5,
+        "device_prefetch": True, "steps_per_dispatch": 8,
+        "corpus": {"p128_only": True, "n_train": 48, "n_val": 6,
+                   "n_test": 4, "batch_size": 4,
+                   "compute_dtype": "float32"},
+    }
+    rec = check_cli_contract_text(
+        "log noise\n" + json.dumps(build_contract(result)), "sustained")
+    assert rec["schema"] == "sustained/v1"
+    assert rec["value"] == 13.7 and 0.0 < rec["ratio_vs_scan"] < 1.0
+    assert rec["device_prefetch"] is True
+
+
+def test_bench_headline_carries_input_pipeline_keys():
+    """The bench input_pipeline section's gated keys ride the contract
+    line (tools/check_perf_regression.py gates
+    input_pipeline.prefetch_overlap_ratio / scan_prefetch_cps)."""
+    import bench
+
+    line = bench._build_headline(
+        {"buckets": {"b1_p128": {"train_scan_complexes_per_sec": 33.0,
+                                 "batch": 1,
+                                 "train_scan_ms_per_step": 30.0}},
+         "input_pipeline": {"prefetch_overlap_ratio": 1.21,
+                            "scan_prefetch_cps": 9.4,
+                            "scan_inline_cps": 7.8,
+                            "per_step_skipped": "deadline"},
+         "interaction_stem": "factorized", "compute_dtype": "float32"},
+        scan_k=8)
+    assert line["input_pipeline"]["prefetch_overlap_ratio"] == 1.21
+    assert line["input_pipeline"]["scan_prefetch_cps"] == 9.4
+    assert "per_step_skipped" not in line["input_pipeline"]
+    rec = check_cli_contract_text(json.dumps(line), "bench")
+    assert rec["value"] == 33.0
 
 
 def test_cli_main_entry(tmp_path, capsys):

@@ -1,0 +1,317 @@
+"""The mesh-aware input pipeline (data/pipeline.py, ISSUE-15).
+
+Coverage map:
+
+* **Prefetch parity matrix** — ``--device_prefetch`` on vs off across all
+  four dispatch modes (single/mesh × per-step/scanned) must produce
+  bit-equal params, identical per-epoch metric values, and ZERO added
+  retraces (placement is a latency optimization, never a math or
+  compile-cache change).
+* **Bounded memory** — the double-buffered placement stage never pins
+  more than ``depth`` placed dispatches ahead of the consumer.
+* **Chaos** — a ``data.place`` fault surfaces as a typed
+  :class:`PlacementError` at the trainer (even when placement ran on the
+  background thread), never a hang; the ``data.place_hang`` watchdog walk
+  lives in tests/test_training_supervisor.py.
+* **Placement-mode log** — fit logs the adopted single/mesh ×
+  per-step/scanned mode once at start.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from deepinteract_tpu.data.loader import BucketedLoader, InMemoryDataset
+from deepinteract_tpu.data.pipeline import (
+    BatchPlacement,
+    PlacementError,
+    is_placed,
+    placed_runs,
+)
+from deepinteract_tpu.data.synthetic import random_raw_complex
+from deepinteract_tpu.parallel.mesh import make_mesh
+from deepinteract_tpu.robustness import faults
+from deepinteract_tpu.training.loop import LoopConfig, Trainer
+from deepinteract_tpu.training.optim import OptimConfig
+
+
+class _ToyPairModel:
+    """Minimal flax model with the DeepInteract call signature (skips the
+    GT encoder's compile cost; same factory idiom as tests/test_stem)."""
+
+    def __new__(cls):
+        class Toy(nn.Module):
+            @nn.compact
+            def __call__(self, g1, g2, train: bool = False):
+                h1 = nn.Dense(4)(g1.node_feats)
+                h2 = nn.Dense(4)(g2.node_feats)
+                pair = jnp.einsum("...if,...jf->...ij", h1, h2)
+                return jnp.stack([-pair, pair], axis=-1)
+
+        return Toy()
+
+
+def _make_loader(n_items=6, batch_size=1, seed=7):
+    rng = np.random.default_rng(seed)
+    raws = [random_raw_complex(12, 10, rng, knn=4, geo_nbrhd_size=2)
+            for _ in range(n_items)]
+    return BucketedLoader(InMemoryDataset(raws), batch_size=batch_size)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# prefetch parity matrix
+
+
+MODES = [
+    ("single_per_step", 1, 0, 1),
+    ("single_scanned", 3, 0, 1),
+    ("mesh_per_step", 1, 2, 2),
+    ("mesh_scanned", 3, 2, 2),
+]
+
+
+def _fit(k, num_data, batch_size, prefetch, monkeypatch):
+    """One 2-epoch fit; returns (params, losses, logs, trace_count)."""
+    from deepinteract_tpu.training import loop as loop_mod
+    from deepinteract_tpu.training import steps as steps_mod
+
+    traces = [0]
+    orig_step = steps_mod.train_step
+
+    def counting_step(*a, **kw):
+        traces[0] += 1
+        return orig_step(*a, **kw)
+
+    # loop.py binds its own import of train_step; steps.multi_train_step
+    # reads the module global — patch both so every trace (per-step jits
+    # AND scan bodies) is counted.
+    monkeypatch.setattr(steps_mod, "train_step", counting_step)
+    monkeypatch.setattr(loop_mod, "train_step", counting_step)
+
+    mesh = make_mesh(num_data=num_data) if num_data else None
+    loader = _make_loader(6, batch_size)
+    logs = []
+    trainer = Trainer(
+        _ToyPairModel(),
+        LoopConfig(num_epochs=2, steps_per_dispatch=k, log_every=0,
+                   device_prefetch=prefetch),
+        OptimConfig(lr=1e-3, steps_per_epoch=6, num_epochs=2),
+        mesh=mesh, log_fn=logs.append,
+    )
+    state = trainer.init_state(next(iter(loader)))
+    state, history = trainer.fit(state, loader)
+    params = jax.tree_util.tree_map(np.asarray, jax.device_get(state.params))
+    losses = [h["train_loss"] for h in history]
+    return params, losses, logs, traces[0]
+
+
+@pytest.mark.parametrize("name,k,num_data,batch_size", MODES)
+def test_prefetch_parity_matrix(name, k, num_data, batch_size, monkeypatch):
+    """Bit-equal params + identical metric values + zero added retraces,
+    prefetch on vs off, in every dispatch mode — the ISSUE-15 acceptance
+    bar for deleting the _install_device_prefetch skip branches."""
+    p_off, l_off, logs_off, traces_off = _fit(
+        k, num_data, batch_size, False, monkeypatch)
+    p_on, l_on, logs_on, traces_on = _fit(
+        k, num_data, batch_size, True, monkeypatch)
+    for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                    jax.tree_util.tree_leaves(p_on)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert l_off == l_on  # identical metric lines, not merely close
+    assert traces_on == traces_off, (
+        f"device_prefetch added retraces: {traces_off} -> {traces_on}")
+    # The adopted placement mode is logged once at fit start, and
+    # prefetch engages (no skip line survives in any mode).
+    mode = ("mesh" if num_data else "single") + "/" + (
+        "scanned" if k > 1 else "per-step")
+    assert any(f"placement mode {mode}, double-buffered" in m
+               for m in logs_on), logs_on
+    assert any(f"placement mode {mode}, inline" in m
+               for m in logs_off), logs_off
+    assert not any("device_prefetch skipped" in m for m in logs_on)
+
+
+def test_placed_batches_are_device_committed():
+    """With prefetch on, the single-device per-step path hands the step
+    function already-placed jax.Arrays (and is_placed recognizes them —
+    the no-double-placement guard)."""
+    placement = BatchPlacement(mesh=None, steps_per_dispatch=1,
+                               transfer=True)
+    loader = _make_loader(2)
+    batch = next(iter(loader))
+    assert not is_placed(batch)
+    placed = placement.place_batch(batch)
+    assert is_placed(placed)
+    # Idempotent: placing a placed batch is a no-op passthrough.
+    assert placement.place_batch(placed) is placed
+
+
+def test_mesh_placement_matches_step_in_shardings():
+    """Batches placed by the pipeline carry exactly the sharding the
+    sharded step functions declare for their batch argument — the
+    single-source-of-truth contract (parallel/mesh.py constructors), so
+    pre-placed arrays are consumed without a reshard copy."""
+    from deepinteract_tpu.parallel.mesh import (
+        batch_sharding,
+        stacked_batch_sharding,
+    )
+
+    mesh = make_mesh(num_data=2)
+    loader = _make_loader(4, batch_size=2)
+    batch = next(iter(loader))
+    placement = BatchPlacement(mesh=mesh, steps_per_dispatch=2,
+                               transfer=True)
+    placed = placement.place_batch(batch)
+    leaf = jax.tree_util.tree_leaves(placed)[0]
+    assert leaf.sharding == batch_sharding(mesh)
+    pr = placement.place_run([batch, batch])
+    assert pr.kind == "stacked"
+    leaf = jax.tree_util.tree_leaves(pr.placed)[0]
+    assert leaf.sharding == stacked_batch_sharding(mesh)
+    assert leaf.shape[0] == 2  # [K, B, ...]
+
+
+def test_prefetch_honors_disabled_loader_readahead():
+    """A loader with prefetch=0 disabled read-ahead deliberately (its
+    memory cap); --device_prefetch must NOT fabricate a pin bound there —
+    placement stays inline with a log line, and training still works."""
+    loader = _make_loader(4)
+    loader.prefetch = 0
+    logs = []
+    trainer = Trainer(
+        _ToyPairModel(),
+        LoopConfig(num_epochs=1, steps_per_dispatch=1, log_every=0,
+                   device_prefetch=True),
+        OptimConfig(lr=1e-3, steps_per_epoch=4, num_epochs=1),
+        log_fn=logs.append,
+    )
+    state = trainer.init_state(next(iter(loader)))
+    _, history = trainer.fit(state, loader)
+    assert trainer._prefetch_depth == 0
+    assert any("placement stays inline" in m for m in logs), logs
+    assert len(history) == 1
+
+
+# ---------------------------------------------------------------------------
+# bounded memory
+
+
+def test_placement_stage_pins_at_most_depth_dispatches():
+    """The double-buffer bound: the background stage never runs more than
+    ``depth`` placements ahead of the consumer (at most ``depth``
+    dispatches of device memory pinned, ISSUE-15 tentpole (c))."""
+
+    class Spy:
+        def __init__(self):
+            self.placed = 0
+
+        def place_run(self, run):
+            self.placed += 1
+            return run
+
+    spy = Spy()
+    depth = 2
+    runs = [[i] for i in range(10)]
+    consumed = 0
+    max_ahead = 0
+    for _ in placed_runs(iter(runs), spy, depth=depth):
+        # Give the worker every chance to run ahead if it (wrongly)
+        # could; the semaphore must hold it at the bound.
+        time.sleep(0.05)
+        consumed += 1
+        max_ahead = max(max_ahead, spy.placed - consumed)
+    assert consumed == 10
+    assert max_ahead <= depth, (
+        f"placement ran {max_ahead} dispatches ahead (bound {depth})")
+
+
+def test_placement_stage_stops_on_abandonment():
+    """Breaking out of the consumer (preemption, viz single-batch pulls)
+    must stop the worker instead of leaving it blocked with pinned
+    batches. Pre-existing workers are excluded by thread IDENTITY (all
+    placement workers share the 'di-placement' name — a name check would
+    pass vacuously whenever an earlier test's worker is still alive)."""
+    threads_before = set(threading.enumerate())
+    gen = placed_runs(iter([[i] for i in range(100)]),
+                      BatchPlacement(transfer=True), depth=1)
+    next(gen)
+    gen.close()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "di-placement"
+                 and t not in threads_before and t.is_alive()]
+        if not alive:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("placement worker outlived its abandoned consumer")
+
+
+# ---------------------------------------------------------------------------
+# chaos: data.place
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("prefetch", [False, True])
+def test_data_place_fault_surfaces_typed_error(prefetch):
+    """A placement failure — inline or on the background thread — must
+    reach the trainer as a typed PlacementError at the next dispatch
+    boundary, never hang the fit on a dead queue."""
+    faults.configure("data.place=1")
+    loader = _make_loader(4)
+    trainer = Trainer(
+        _ToyPairModel(),
+        LoopConfig(num_epochs=1, steps_per_dispatch=2, log_every=0,
+                   device_prefetch=prefetch),
+        OptimConfig(lr=1e-3, steps_per_epoch=4, num_epochs=1),
+        log_fn=lambda _s: None,
+    )
+    state = trainer.init_state(next(iter(loader)))
+    with pytest.raises(PlacementError, match="data.place"):
+        trainer.fit(state, loader)
+
+
+@pytest.mark.chaos
+def test_data_place_fault_counts_injection():
+    faults.configure("data.place=1")
+    placement = BatchPlacement(transfer=True)
+    with pytest.raises(PlacementError):
+        placement.place_batch({"x": np.zeros(3, np.float32)})
+    assert faults.call_count("data.place") == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+
+
+def test_h2d_metrics_count_placements():
+    """Placements record di_data_h2d_seconds/bytes and the per-mode
+    dispatch counter (the obs series the ISSUE-15 telemetry satellite
+    names)."""
+    from deepinteract_tpu.data import pipeline as pipeline_mod
+
+    before_b = pipeline_mod._H2D_BYTES.value()
+    before_s = pipeline_mod._H2D_SECONDS.value()
+    before_d = pipeline_mod._PLACED_DISPATCHES.value(mode="single/per-step")
+    placement = BatchPlacement(transfer=True)
+    batch = {"x": np.zeros((4, 8), np.float32)}
+    placement.place_batch(batch)
+    assert pipeline_mod._H2D_BYTES.value() >= before_b + 4 * 8 * 4
+    assert pipeline_mod._H2D_SECONDS.value() >= before_s
+    assert pipeline_mod._PLACED_DISPATCHES.value(
+        mode="single/per-step") == before_d + 1

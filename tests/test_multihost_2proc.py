@@ -342,6 +342,35 @@ def test_two_process_midepoch_kill9_supervised_resume_parity(tmp_path):
 
 @pytest.mark.slow
 @pytest.mark.chaos
+def test_two_process_device_prefetch_local_shard_parity(tmp_path):
+    """ISSUE-15: --device_prefetch on a REAL 2-host mesh under scanned
+    dispatch. The placement stage must build global arrays from each
+    host's LOCAL shard only (make_array_from_process_local_data — a host
+    placing global data would misshape the first collective and deadlock
+    or crash the pair), engage prefetch (mode log line; no skip branch
+    survives), and keep the hosts' epochs aligned: every per-epoch metric
+    line matches the no-prefetch reference exactly on both hosts."""
+    root = tmp_path / "data"
+    _build_tiny_dataset(str(root))
+    ref = _run_two_procs(tmp_path, root, "noprefetch", num_epochs=2,
+                         extra_flags=["--steps_per_dispatch", "2"])
+    pre = _run_two_procs(tmp_path, root, "prefetch", num_epochs=2,
+                         extra_flags=["--steps_per_dispatch", "2",
+                                      "--device_prefetch"])
+    for pid in range(2):
+        assert ("placement mode mesh/scanned, double-buffered"
+                in pre[pid]), pre[pid][-2000:]
+        assert "each host places its local shard" in pre[pid]
+        assert "device_prefetch skipped" not in pre[pid]
+    for epoch in (0, 1):
+        lines = {_epoch_line(out, epoch) for out in ref + pre}
+        assert len(lines) == 1, (
+            f"epoch {epoch} metric lines diverged across hosts or "
+            f"prefetch modes: {lines}")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
 def test_two_process_skip_budget_drop_is_host0_broadcast(tmp_path):
     """ISSUE-14 satellite: --data_skip_budget on a mesh. A corrupt batch
     on ONE host (fault plan injected into host 1 only) must be dropped

@@ -559,46 +559,9 @@ class _ToyPairModel:
         return Toy()
 
 
-def test_trainer_device_prefetch_same_numerics():
-    """--device_prefetch must be a pure latency optimization: identical
-    training results, h2d issued by the loader thread."""
-    from deepinteract_tpu.training.loop import LoopConfig, Trainer
-    from deepinteract_tpu.training.optim import OptimConfig
-
-    def run(device_prefetch):
-        loader = _toy_loader(np.random.default_rng(7))
-        trainer = Trainer(
-            _ToyPairModel(),
-            LoopConfig(num_epochs=1, steps_per_dispatch=1, log_every=0,
-                       device_prefetch=device_prefetch),
-            OptimConfig(lr=1e-3, steps_per_epoch=3, num_epochs=1),
-            log_fn=lambda s: None,
-        )
-        state = trainer.init_state(next(iter(loader)))
-        state, history = trainer.fit(state, loader)
-        if device_prefetch:
-            assert loader.device_transfer is not None
-        return history[0]["train_loss"]
-
-    assert run(False) == pytest.approx(run(True), rel=1e-6)
-
-
-def test_trainer_device_prefetch_skipped_under_scan():
-    """With steps_per_dispatch > 1 the hook must NOT install (scanned
-    dispatches stack host batches — the loop.py h2d caveat)."""
-    from deepinteract_tpu.training.loop import LoopConfig, Trainer
-    from deepinteract_tpu.training.optim import OptimConfig
-
-    loader = _toy_loader(np.random.default_rng(7))
-    logs = []
-    trainer = Trainer(
-        _ToyPairModel(),
-        LoopConfig(num_epochs=1, steps_per_dispatch=4, log_every=0,
-                   device_prefetch=True),
-        OptimConfig(lr=1e-3, steps_per_epoch=3, num_epochs=1),
-        log_fn=logs.append,
-    )
-    state = trainer.init_state(next(iter(loader)))
-    trainer.fit(state, loader)
-    assert loader.device_transfer is None
-    assert any("device_prefetch skipped" in m for m in logs)
+# The Trainer-side --device_prefetch tests moved to
+# tests/test_input_pipeline.py (ISSUE-15): prefetch no longer rides the
+# loader's device_transfer hook — placement is a pipeline stage
+# (data/pipeline.py) engaging in all four dispatch modes, parity- and
+# trace-count-tested there. The loader-hook test above stays: the hook
+# remains a loader feature for external consumers.

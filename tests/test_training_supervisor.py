@@ -536,3 +536,41 @@ def test_supervised_hang_injection_watchdog_kills_and_resumes(
     assert "resumed from epoch 1" in out
     # The run finished every epoch after the resume.
     assert set(_epoch_lines(out)) == {0, 1}
+
+
+def test_supervised_wedged_placement_thread_watchdog_kills_and_resumes(
+        tmp_path, train_env):
+    """ISSUE-15 chaos walk: a data.place_hang fault freezes the input
+    pipeline's PLACEMENT THREAD (--device_prefetch on, scanned dispatch)
+    while the heartbeat daemon keeps beating — the dispatch loop blocks
+    on a queue that will never fill, progress goes stale, and the PR-14
+    watchdog must SIGKILL + restart into --resume exactly as it does for
+    a wedged collective. The restarted child spawns without the fault
+    plan and finishes honestly."""
+    ckpt = tmp_path / "ckpt"
+    proc = _run(_train_cmd(train_env, ckpt, [
+        "--supervise", "--save_every_steps", "1",
+        "--device_prefetch", "--steps_per_dispatch", "2",
+        "--heartbeat_seconds", "0.2", "--watch_interval_s", "0.1",
+        "--hang_timeout_s", "3", "--start_grace_s", "300",
+        "--train_restart_backoff_s", "0.2",
+        "--num_epochs", "2"]), tmp_path, popen=True,
+        # 4th placement = epoch 1's SECOND dispatch: mid-epoch, after a
+        # cadence save, so the restarted child resumes mid-epoch-1 and
+        # its post-restore compile stays inside the start grace (an
+        # epoch-boundary hang would re-tick the boundary on resume and
+        # end the grace before the first compile — the same constraint
+        # the training.hang test above observes with @6).
+        env_extra={"DI_FAULTS": "data.place_hang=@4"})
+    out, _ = proc.communicate(timeout=420)
+    assert proc.returncode == 0, out[-4000:]
+    rec = check_cli_contract_text(out, "train_supervise")
+    assert rec["ok"] is True
+    assert rec["hang_kills"] == 1 and rec["restarts"] == 1
+    assert "data.place_hang fault injected" in out
+    assert "wedged" in out
+    assert "resumed from epoch 1" in out
+    # Prefetch engaged (no skip branch exists anymore), and the run
+    # finished every epoch after the resume.
+    assert "double-buffered on the placement thread" in out
+    assert set(_epoch_lines(out)) == {0, 1}

@@ -90,6 +90,19 @@ CONTRACTS = {
                     "corrupt", "quarantined", "tmp_files",
                     "stale_heartbeats"),
     },
+    # sustained/v1: tools/sustained_train.py — end-to-end sustained
+    # training rate, the device-resident scanned micro-bench it is
+    # divided by, and ratio_vs_scan (the ROADMAP item 4 >=0.70 bar);
+    # keys must stay in sync with sustained_train.build_contract.
+    "sustained": {
+        "required": ("schema", "metric", "value", "unit",
+                     "ratio_vs_scan", "scan_complexes_per_sec", "epochs",
+                     "n_train", "steady_epoch_s", "device_prefetch",
+                     "steps_per_dispatch", "corpus"),
+        "numeric": ("value", "ratio_vs_scan", "scan_complexes_per_sec",
+                    "epochs", "n_train", "steady_epoch_s",
+                    "steps_per_dispatch"),
+    },
     # train_supervise/v1: cli/train.py --supervise (training/
     # supervisor.py TrainingSupervisor.contract): supervised restarts,
     # hang kills, circuit state, and the honest child exit code.

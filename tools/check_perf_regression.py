@@ -92,6 +92,19 @@ TOLERANCES = {
     # mid/ checkpoint stopped landing.
     "recovery.mttr_s": (1.00, -1),
     "recovery.steps_reexecuted": (0.0, -1),
+    # Input-pipeline contract (bench `input_pipeline` section, ISSUE-15):
+    # prefetch_overlap_ratio is the stepped-loader rate with placement
+    # double-buffered on the prefetch thread over the inline-placement
+    # rate under scanned dispatch — the overlap must keep paying for
+    # itself; the absolute prefetch-on scanned rate rides along.
+    "input_pipeline.prefetch_overlap_ratio": (0.25, +1),
+    "input_pipeline.scan_prefetch_cps": (0.35, +1),
+    # Sustained-training contract (tools/sustained_train.py sustained/v1,
+    # ISSUE-15): sustained/micro-bench-scan ratio, the ROADMAP item 4
+    # >=0.70 bar. Dormant until a blessed baseline carries the key (the
+    # bless happens on hardware — the ratio is workload-shaped); once
+    # present it gates like every other throughput ratio.
+    "sustained.ratio_vs_scan": (0.25, +1),
 }
 # Lower-better keys whose baseline is legitimately 0 (e.g. dropped
 # requests): relative tolerance math is undefined at 0, so these gate as
