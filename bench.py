@@ -102,6 +102,7 @@ SECTION_EST_S = {
     "input_pipeline": 420,
     "saturation": 240,
     "rollover": 180,
+    "elasticity": 200,
     "recovery": 240,
     "attribution": 240,
 }
@@ -582,8 +583,8 @@ def _section_names(platform: str) -> list:
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
              "b1_p256", "b1_p384_tiled", "eval_path", "screening",
-             "saturation", "rollover", "recovery", "attribution",
-             "input_pipeline"]
+             "saturation", "rollover", "elasticity", "recovery",
+             "attribution", "input_pipeline"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -1519,6 +1520,212 @@ def _run_rollover_section(ctx, detail) -> None:
     _dump_partial(detail)
 
 
+def _run_elasticity_section(ctx, detail) -> None:
+    """Elastic-fleet disruption budget (ISSUE-16): a LIVE autoscaler over
+    stub workers rides a diurnal-shaped trace — steady trickle, a burst
+    that must scale the fleet UP (with a mid-burst preemption injected as
+    the expected spot-loss event), then a drop that must scale it back
+    DOWN — while closed-loop clients measure the tail end to end.
+
+    Like the rollover section, stub workers with a fixed simulated device
+    latency isolate the FLEET LAYER's contribution: warm-before-adopt
+    scale-up, release-then-drain scale-down, preemption replacement. The
+    gated keys: ``dropped_requests`` (non-200 answers across ALL phases;
+    the bar is ZERO — elasticity must never shed correct traffic) and
+    ``p99_ratio`` (burst-phase p99 over the steady baseline through the
+    SAME router). The section raises — emitting NO gated keys — unless
+    the autoscaler actually scaled up, scaled down, AND absorbed the
+    preemption: steady numbers over a static fleet would trivially pass."""
+    import tempfile
+    import threading as _threading
+
+    from deepinteract_tpu.serving.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+    )
+    from deepinteract_tpu.serving.fleet import (
+        FleetConfig,
+        WorkerSupervisor,
+        request_json,
+        stub_worker_cmd,
+    )
+    from deepinteract_tpu.serving.router import FleetRouter, RouterConfig
+
+    steady_clients = int(os.environ.get("DI_BENCH_ELASTIC_STEADY_CLIENTS",
+                                        "2"))
+    burst_clients = int(os.environ.get("DI_BENCH_ELASTIC_BURST_CLIENTS",
+                                       "8"))
+    steady_s = float(os.environ.get("DI_BENCH_ELASTIC_STEADY", "3"))
+    burst_s = float(os.environ.get("DI_BENCH_ELASTIC_BURST", "10"))
+    drop_s = float(os.environ.get("DI_BENCH_ELASTIC_DROP", "8"))
+    delay_ms = 20.0
+    state_dir = tempfile.mkdtemp(prefix="di_bench_elastic_")
+    supervisor = WorkerSupervisor(
+        stub_worker_cmd,
+        FleetConfig(num_workers=1, probe_interval_s=0.15,
+                    heartbeat_max_age_s=5.0, state_dir=state_dir),
+        overrides={"weights_signature": "bench-v1",
+                   "delay_ms": delay_ms,
+                   "heartbeat_interval_s": 0.2})
+    router = FleetRouter(
+        supervisor, port=0,
+        cfg=RouterConfig(proxy_timeout_s=10.0, warm_timeout_s=60.0,
+                         drain_timeout_s=30.0))
+    scaler = Autoscaler(
+        supervisor, router,
+        cfg=AutoscalerConfig(min_workers=1, max_workers=3,
+                             interval_s=0.3, queue_high=1.5,
+                             queue_low=0.2, breach_polls=2,
+                             cooldown_s=1.5, warm_timeout_s=60.0,
+                             drain_timeout_s=30.0),
+        overrides={"weights_signature": "bench-v1",
+                   "delay_ms": delay_ms,
+                   "heartbeat_interval_s": 0.2})
+    entry = {"stub_delay_ms": delay_ms,
+             "steady_clients": steady_clients,
+             "burst_clients": burst_clients,
+             "steady_s": steady_s, "burst_s": burst_s, "drop_s": drop_s,
+             "protocol": "closed-loop diurnal trace (steady/burst/drop) "
+                         "through the router under a live autoscaler; "
+                         "one preemption injected mid-burst"}
+    detail["elasticity"] = entry
+    peak = {"workers": 0}
+    try:
+        router.start()
+        host, port = router.address
+        warm_deadline = time.monotonic() + 60.0
+        while (not supervisor.routable_workers()
+               and time.monotonic() < warm_deadline):
+            supervisor.poll_once()
+            time.sleep(0.05)
+        if not supervisor.routable_workers():
+            raise RuntimeError("seed worker never became routable")
+        scaler.start()
+
+        lock = _threading.Lock()
+
+        def closed_loop(samples, stop_at):
+            while time.monotonic() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    status, _ = request_json(host, port, "POST",
+                                             "/predict", body=b"{}",
+                                             timeout_s=10.0)
+                except Exception:
+                    status = -1
+                with lock:
+                    samples.append((time.perf_counter() - t0, status))
+
+        def run_phase(clients, seconds):
+            samples = []
+            stop_at = time.monotonic() + seconds
+            threads = [_threading.Thread(target=closed_loop,
+                                         args=(samples, stop_at))
+                       for _ in range(clients)]
+            for t in threads:
+                t.start()
+            while time.monotonic() < stop_at:
+                peak["workers"] = max(
+                    peak["workers"],
+                    len(supervisor.routable_workers()))
+                time.sleep(0.1)
+            for t in threads:
+                t.join()
+            return samples
+
+        # Phase 1 — steady trickle: the baseline tail, fleet at 1.
+        samples = run_phase(steady_clients, steady_s)
+        lat = sorted(s for s, status in samples if status == 200)
+        if not lat:
+            raise RuntimeError("steady phase served nothing")
+        dropped = sum(1 for _, status in samples if status != 200)
+        entry["steady_requests"] = len(samples)
+        entry["steady_p99_ms"] = round(_nearest_rank(lat, 0.99) * 1e3, 2)
+        _dump_partial(detail)
+
+        # Phase 2 — burst: the autoscaler must grow the fleet; one
+        # preemption lands mid-burst as the expected spot-loss event.
+        def preempt_mid_burst():
+            time.sleep(burst_s / 2.0)
+            victims = supervisor.routable_workers()
+            if victims:
+                supervisor.preempt_worker(victims[-1]["worker_id"])
+
+        trig = _threading.Thread(target=preempt_mid_burst)
+        trig.start()
+        samples = run_phase(burst_clients, burst_s)
+        trig.join(timeout=30.0)
+        lat = sorted(s for s, status in samples if status == 200)
+        if not lat:
+            raise RuntimeError("burst phase served nothing")
+        dropped += sum(1 for _, status in samples if status != 200)
+        entry["burst_requests"] = len(samples)
+        entry["p99_during_scale_ms"] = round(
+            _nearest_rank(lat, 0.99) * 1e3, 2)
+        entry["p99_ratio"] = round(
+            entry["p99_during_scale_ms"]
+            / max(entry["steady_p99_ms"], 1e-9), 2)
+        _dump_partial(detail)
+
+        # Phase 3 — drop: back to the trickle; the autoscaler must
+        # release-and-drain the surplus without dropping the remainder.
+        samples = run_phase(steady_clients, drop_s)
+        dropped += sum(1 for _, status in samples if status != 200)
+        entry["drop_requests"] = len(samples)
+
+        stats = scaler.stats()
+        sup_stats = supervisor.stats()
+        entry["scale_ups"] = stats["scale_ups"]
+        entry["scale_downs"] = stats["scale_downs"]
+        entry["autoscale_errors"] = stats["errors"]
+        entry["preemptions"] = sup_stats["preemptions"]
+        entry["peak_workers"] = peak["workers"]
+        entry["final_workers"] = len(supervisor.routable_workers())
+        entry["dropped_requests"] = dropped
+        # Honest completion: the gated keys mean nothing unless the
+        # trace actually exercised every capacity event. A static fleet
+        # shows 0 drops and a flat p99 while the capability is broken.
+        problems = []
+        if entry["scale_ups"] < 1:
+            problems.append("never scaled up under the burst")
+        if entry["scale_downs"] < 1:
+            problems.append("never scaled down after the drop")
+        if entry["preemptions"] < 1:
+            problems.append("the injected preemption never landed")
+        if problems:
+            entry.pop("p99_ratio", None)
+            entry.pop("dropped_requests", None)
+            raise RuntimeError(
+                "elasticity trace incomplete — gated keys withheld: "
+                + "; ".join(problems)
+                + " (raise DI_BENCH_ELASTIC_BURST / _DROP on this "
+                  "machine)")
+        entry["note"] = (
+            "stub-worker fleet isolates the fleet layer's elasticity "
+            "cost (warm-before-adopt scale-up, release-then-drain "
+            "scale-down, preemption replacement); dropped_requests "
+            "counts every non-200 answer across all three phases — "
+            "the bar is 0")
+    finally:
+        try:
+            scaler.stop()
+        except Exception:
+            pass
+        try:
+            router.drain()
+        except Exception:
+            pass
+        import shutil
+
+        shutil.rmtree(state_dir, ignore_errors=True)
+    _log(json.dumps({"elasticity": {
+        k: entry.get(k) for k in (
+            "steady_p99_ms", "p99_during_scale_ms", "p99_ratio",
+            "dropped_requests", "scale_ups", "scale_downs",
+            "preemptions", "peak_workers", "final_workers")}}))
+    _dump_partial(detail)
+
+
 def _run_recovery_section(ctx, detail) -> None:
     """Self-healing training MTTR (ISSUE-14): a REAL supervised
     ``cli.train --supervise`` run over a tiny synthetic dataset, its
@@ -1824,8 +2031,8 @@ def _section_result_key(name: str):
     if name == "eval_path":
         return None, "eval_path_b128"
     if name in ("tuned_ab", "stem_ab", "precision_ab", "screening",
-                "saturation", "rollover", "recovery", "attribution",
-                "input_pipeline"):
+                "saturation", "rollover", "elasticity", "recovery",
+                "attribution", "input_pipeline"):
         return None, name
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
@@ -1860,6 +2067,8 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_saturation_section(ctx, detail)
     elif name == "rollover":
         _run_rollover_section(ctx, detail)
+    elif name == "elasticity":
+        _run_elasticity_section(ctx, detail)
     elif name == "recovery":
         _run_recovery_section(ctx, detail)
     elif name == "attribution":
@@ -1994,6 +2203,22 @@ def _build_headline(detail, scan_k) -> dict:
                       "requests_during_rollover", "rollover_elapsed_s",
                       "failovers", "workers")
             if k in rollover}
+    elasticity = detail.get("elasticity", {})
+    if "p99_during_scale_ms" in elasticity:
+        # Elastic-fleet contract keys (ISSUE-16): burst-phase tail over
+        # the steady baseline while the autoscaler grows/shrinks the
+        # fleet and absorbs a preemption, and the dropped-request count
+        # whose bar is zero. Gated in tools/check_perf_regression.py;
+        # only emitted when the trace actually scaled up, scaled down,
+        # and landed the preemption (_run_elasticity_section raises
+        # otherwise).
+        line["elasticity"] = {
+            k: elasticity[k]
+            for k in ("p99_during_scale_ms", "steady_p99_ms",
+                      "p99_ratio", "dropped_requests", "scale_ups",
+                      "scale_downs", "preemptions", "peak_workers",
+                      "final_workers")
+            if k in elasticity}
     recovery = detail.get("recovery", {})
     if "mttr_s" in recovery:
         # Self-healing training contract keys (ISSUE-14): kill-to-first-
@@ -2047,7 +2272,8 @@ def _is_partial(detail) -> bool:
     candidates += [v for k, v in detail.items()
                    if k.startswith(("attention_ab", "eval_path", "tuned_ab",
                                     "stem_ab", "precision_ab", "screening",
-                                    "saturation", "rollover", "recovery",
+                                    "saturation", "rollover", "elasticity",
+                                    "recovery",
                                     "attribution", "input_pipeline"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates

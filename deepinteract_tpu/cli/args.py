@@ -378,6 +378,40 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
                    help="with --rollover: required weights_signature the "
                         "replacements must report before traffic "
                         "switches (verifies the right weights landed)")
+    f.add_argument("--autoscale", action="store_true",
+                   help="with --workers: run the elastic capacity "
+                        "controller (serving/autoscaler.py) — grow/"
+                        "shrink the worker set from queue depth, shed "
+                        "pressure, and router p99, with hysteresis + "
+                        "cooldown, warm-before-adopt scale-up, and "
+                        "drain-through scale-down")
+    f.add_argument("--autoscale_min_workers", type=int, default=1,
+                   help="autoscaler floor: never drain below this many "
+                        "workers")
+    f.add_argument("--autoscale_max_workers", type=int, default=4,
+                   help="autoscaler ceiling: never spawn above this many "
+                        "workers")
+    f.add_argument("--autoscale_interval_s", type=float, default=1.0,
+                   help="autoscaler control period (signal sample + "
+                        "streak advance per tick)")
+    f.add_argument("--autoscale_queue_high", type=float, default=2.0,
+                   help="mean in-flight per routable worker at/above "
+                        "which a poll counts as a scale-UP breach")
+    f.add_argument("--autoscale_queue_low", type=float, default=0.25,
+                   help="mean in-flight per routable worker at/below "
+                        "which (with no shed pressure) a poll counts as "
+                        "a scale-DOWN breach")
+    f.add_argument("--autoscale_breach_polls", type=int, default=3,
+                   help="consecutive breaching polls required before the "
+                        "autoscaler acts (hysteresis)")
+    f.add_argument("--autoscale_cooldown_s", type=float, default=10.0,
+                   help="hold-down after any autoscale action — no "
+                        "further action regardless of signals (anti-"
+                        "flap)")
+    f.add_argument("--versions", action="store_true",
+                   help="client mode: GET /admin/versions from the fleet "
+                        "router at --host/--port and exit (final stdout "
+                        "line is the versions/v1 contract)")
 
 
 def add_screening_args(p: argparse.ArgumentParser) -> None:

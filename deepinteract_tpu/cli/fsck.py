@@ -188,6 +188,105 @@ def _check_trainer_cursor(path: str, report: Dict) -> None:
     }
 
 
+def _check_fleet_state(path: str, report: Dict) -> None:
+    """Validate the elastic-fleet records riding fleet_state.json
+    (serving/fleet.py ``set_extra_state``): the autoscaler's persisted
+    target (serving/autoscaler.py) and the router's version weights /
+    shadow config (serving/router.py). A structurally damaged record
+    would be resumed verbatim by the next supervisor life — a malformed
+    target respawns the wrong fleet, malformed weights break the canary
+    split — so it is flagged (and quarantined) as corruption, not styled
+    over. Healthy records surface in the fsck/v1 contract: per-version
+    worker counts, the autoscale target, and any stale agreement ledgers
+    (``agreement_<version>.jsonl`` for a version that is neither weighted
+    nor the shadow candidate — promotion evidence nothing can consume)."""
+    if any(e["path"] == path for e in report["corrupt_paths"]):
+        return  # integrity layer already flagged (and maybe moved) it
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return  # already flagged by the parse checks above
+    if not isinstance(payload, dict):
+        return
+    problems = []
+
+    def nonneg_int(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+    autoscale = payload.get("autoscale")
+    if autoscale is not None:
+        if not isinstance(autoscale, dict):
+            problems.append("autoscale is not an object")
+        else:
+            for key in ("target_workers", "scale_ups", "scale_downs"):
+                if key in autoscale and not nonneg_int(autoscale[key]):
+                    problems.append(
+                        f"autoscale.{key} is not a non-negative int")
+            if not nonneg_int(autoscale.get("target_workers")):
+                problems.append("autoscale.target_workers missing")
+    versions = payload.get("versions")
+    weights, shadow = {}, None
+    if versions is not None:
+        if not isinstance(versions, dict):
+            problems.append("versions is not an object")
+        else:
+            weights = versions.get("weights", {})
+            if (not isinstance(weights, dict)
+                    or not all(isinstance(k, str)
+                               and isinstance(v, (int, float))
+                               and not isinstance(v, bool) and v >= 0
+                               for k, v in weights.items())):
+                problems.append("versions.weights is not a "
+                                "version->non-negative-number map")
+                weights = {}
+            shadow = versions.get("shadow")
+            if shadow is not None and (
+                    not isinstance(shadow, dict)
+                    or not isinstance(shadow.get("candidate"), str)):
+                problems.append("versions.shadow has no candidate")
+                shadow = None
+            if ("promotions" in versions
+                    and not nonneg_int(versions["promotions"])):
+                problems.append("versions.promotions is not a "
+                                "non-negative int")
+    if problems:
+        _mark_corrupt(path, "fleet state records malformed: "
+                      + "; ".join(problems), "fleet-state", report)
+        return
+    by_version: Dict[str, int] = {}
+    workers = payload.get("workers")
+    if isinstance(workers, dict):
+        for snap in workers.values():
+            if not isinstance(snap, dict) or snap.get("state") != "healthy":
+                continue
+            health = snap.get("health")
+            sig = (health.get("weights_signature")
+                   if isinstance(health, dict) else None)
+            if isinstance(sig, str):
+                by_version[sig] = by_version.get(sig, 0) + 1
+    entry: Dict = {"workers_by_version": by_version}
+    if isinstance(autoscale, dict):
+        entry["autoscale_target"] = autoscale.get("target_workers")
+    if weights:
+        entry["version_weights"] = weights
+    report["fleet_versions"] = entry
+    # Agreement ledgers beside the state file that no live version can
+    # consume: promotion evidence for a version that is neither weighted
+    # nor shadowed is stale — it must never promote by accident.
+    live = set(weights) | ({shadow["candidate"]} if shadow else set())
+    state_dir = os.path.dirname(path)
+    try:
+        names = sorted(os.listdir(state_dir))
+    except OSError:
+        names = []
+    for name in names:
+        if (name.startswith("agreement_") and name.endswith(".jsonl")
+                and name[len("agreement_"):-len(".jsonl")] not in live):
+            report.setdefault("stale_version_ledgers", []).append(
+                os.path.join(state_dir, name))
+
+
 def _mark_corrupt(path: str, reason: str, kind: str, report: Dict) -> None:
     report["corrupt_paths"].append({"path": path, "kind": kind,
                                     "reason": reason})
@@ -238,6 +337,8 @@ def scan(root: str, do_quarantine: bool, do_sweep: bool) -> Dict:
                 _check_file(path, report, require_sidecar=spill)
             if name == "trainer_state.json":
                 _check_trainer_cursor(path, report)
+            if name == "fleet_state.json":
+                _check_fleet_state(path, report)
             if name.startswith("heartbeat") and name.endswith(".json"):
                 _check_heartbeat(path, report)
     if do_sweep or do_quarantine:
@@ -286,6 +387,9 @@ def main(argv=None) -> int:
         print(f"unverified (no integrity sidecar): {path}")
     for path in report["orphan_sidecars"]:
         print(f"orphan sidecar (target gone): {path}")
+    for path in report.get("stale_version_ledgers", []):
+        print("stale version ledger (version neither weighted nor "
+              f"shadowed): {path}")
     for path in report["tmp_paths"]:
         swept = " (swept)" if (args.sweep_tmp or args.quarantine) else ""
         print(f"orphan tmp: {path}{swept}")
@@ -311,6 +415,8 @@ def main(argv=None) -> int:
         "stale_heartbeats": report.get("stale_heartbeats", 0),
         "stale_heartbeat_hosts": report.get("stale_heartbeat_hosts", []),
         "resume_cursor": report.get("resume_cursor"),
+        "fleet_versions": report.get("fleet_versions"),
+        "stale_version_ledgers": report.get("stale_version_ledgers", []),
         "tmp_files": len(report["tmp_paths"]),
         "tmp_swept": report["tmp_swept"],
         "corrupt_paths": [e["path"] for e in report["corrupt_paths"][:20]],

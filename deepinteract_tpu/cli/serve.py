@@ -32,6 +32,14 @@ swaps the engine workers for ``serving/worker_stub.py`` null engines
 the router at ``--host``/``--port`` (optionally with ``--rollover_ckpt``
 / ``--rollover_signature``) and exits 0 iff the rollover completed; the
 final stdout line is the router's ``fleet/v1`` response.
+
+``--autoscale`` (with ``--workers``) adds the elastic capacity
+controller (``serving/autoscaler.py``): the worker set grows/shrinks
+between ``--autoscale_min_workers`` and ``--autoscale_max_workers``
+from live overload signals, with hysteresis + cooldown, warm-before-
+adopt scale-up, and drain-through scale-down. ``--versions`` is the
+matching admin client: it fetches ``GET /admin/versions`` and exits
+with the ``versions/v1`` contract as the final stdout line.
 """
 
 from __future__ import annotations
@@ -164,14 +172,38 @@ def _fleet_main(args, argv: List[str], guard=None) -> int:
             warm_timeout_s=args.fleet_warm_timeout_s,
         ))
     router.start()
+    autoscaler = None
+    if args.autoscale:
+        from deepinteract_tpu.serving.autoscaler import (
+            Autoscaler,
+            AutoscalerConfig,
+        )
+
+        autoscaler = Autoscaler(
+            supervisor, router,
+            cfg=AutoscalerConfig(
+                min_workers=args.autoscale_min_workers,
+                max_workers=args.autoscale_max_workers,
+                interval_s=args.autoscale_interval_s,
+                queue_high=args.autoscale_queue_high,
+                queue_low=args.autoscale_queue_low,
+                breach_polls=args.autoscale_breach_polls,
+                cooldown_s=args.autoscale_cooldown_s,
+                warm_timeout_s=args.fleet_warm_timeout_s,
+            ),
+            overrides=dict(base_overrides))
+        autoscaler.start()
     host, port = router.address
     print(f"fleet router on http://{host}:{port} "
           f"({args.workers} worker(s)"
-          f"{', stub' if args.fleet_stub_workers else ''}; "
+          f"{', stub' if args.fleet_stub_workers else ''}"
+          f"{', autoscaling' if autoscaler is not None else ''}; "
           f"state in {state_dir})", flush=True)
     try:
         return router.run(guard=guard)
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         print(json.dumps(router.final_contract()), flush=True)
 
 
@@ -203,6 +235,20 @@ def _rollover_main(args) -> int:
     return 0 if status == 200 and roll.get("ok") else 1
 
 
+def _versions_main(args) -> int:
+    """One-shot versions client: fetch the router's multi-version state
+    (canary weights, per-version worker counts, shadow agreement); the
+    final stdout line is the ``versions/v1`` contract."""
+    from deepinteract_tpu.serving.fleet import request_json
+
+    status, record = request_json(
+        args.host, args.port, "GET", "/admin/versions",
+        timeout_s=args.request_timeout_s)
+    print(f"versions answered {status}", flush=True)
+    print(json.dumps(record), flush=True)
+    return 0 if status == 200 and isinstance(record, dict) else 1
+
+
 def main(argv=None, guard=None) -> int:
     parser = build_parser(__doc__)
     add_serving_args(parser)
@@ -210,6 +256,8 @@ def main(argv=None, guard=None) -> int:
 
     if args.rollover:
         return _rollover_main(args)
+    if args.versions:
+        return _versions_main(args)
     if args.workers > 0:
         return _fleet_main(
             args, list(sys.argv[1:] if argv is None else argv),

@@ -49,6 +49,13 @@ Registered sites:
 * ``fleet.kill``          — raises OSError when the supervisor delivers
   a signal to a worker (a drain's SIGTERM fails; the SIGKILL fallback
   must still retire the worker)
+* ``fleet.preempt``       — boolean site fired once per supervisor poll
+  tick: when it fires, the newest routable worker is preempted (SIGTERM,
+  expected capacity loss — no circuit penalty, immediate replacement
+  spawn; serving/fleet.py poll_once)
+* ``autoscale.decision``  — raises RuntimeError at the moment an
+  autoscaler decision would commit (serving/autoscaler.py); the tick
+  must swallow it, count it, and leave the fleet unchanged
 * ``training.step_crash`` — raises RuntimeError at that train batch
   (hard process crash with a traceback — the training supervisor's
   restart-into---resume path, training/supervisor.py)

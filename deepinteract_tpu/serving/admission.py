@@ -429,3 +429,17 @@ def expired_counter(where: str) -> None:
     """Count one deadline expiry at ``where`` (admission / queue /
     screen) — one helper so every layer shares the same series."""
     _DEADLINE_EXPIRED.inc(where=where)
+
+
+def overload_signals() -> Dict[str, float]:
+    """Process-local overload evidence in one readout — the capacity
+    controller's (``serving/autoscaler.py``) admission-layer inputs.
+    ``admission_rejected`` / ``shed_rejected`` are CUMULATIVE counts
+    (pollers diff between reads); ``shed_degraded`` is the live 0/1
+    shedder state."""
+    rejected = sum(value for _, _, value in _REJECTED.samples())
+    return {
+        "admission_rejected": float(rejected),
+        "shed_rejected": _SHED_REJECTED.value(),
+        "shed_degraded": _SHED_DEGRADED.value(),
+    }

@@ -30,15 +30,32 @@ or the ``serving/worker_stub.py`` rehearsal double):
   SIGTERM path (PR-1/PR-11 discipline: finish in-flight, exit 0) and
   mark them retired so an expected exit is never misread as a crash.
 
+* **preempt** — spot/preemptible capacity loss is a FIRST-CLASS event,
+  not a crash: :meth:`WorkerSupervisor.preempt_worker` marks the worker
+  ``preempted`` and SIGTERMs it (the worker's own drain path finishes
+  in-flight work), and when the process exits the supervisor retires it
+  with NO circuit-breaker penalty and spawns a replacement immediately
+  (no backoff — the capacity is wanted back now). ``fleet.preempt`` is
+  the chaos site: a planned firing inside :meth:`poll_once` preempts the
+  newest healthy worker, so the chaos suite and the bench ``elasticity``
+  section inject preemptions deterministically.
+
 Chaos sites (``robustness/faults.py``): ``fleet.spawn`` fails a worker
 spawn (exercises the backoff path), ``fleet.probe`` poisons a health
 probe (worker looks unreachable), ``fleet.kill`` fails the SIGTERM of a
-drain (the SIGKILL fallback must still retire the worker).
+drain (the SIGKILL fallback must still retire the worker),
+``fleet.preempt`` injects a preemption event at a supervision tick.
 
 Supervisor state (worker states, restart counts, exit codes) is
 persisted to ``<state_dir>/fleet_state.json`` through
 ``robustness/artifacts.atomic_write`` after every transition, so an
-operator (or fsck) reading mid-crash never sees torn JSON.
+operator (or fsck) reading mid-crash never sees torn JSON. Control-plane
+records ride the same file: :meth:`WorkerSupervisor.set_extra_state`
+merges e.g. the autoscaler's target and the router's version weights
+into the payload, and a restarted supervisor recovers them (plus reaps
+any still-alive workers the dead supervisor left behind) via
+:func:`load_persisted_state` before spawning its own fleet — kill -9
+mid-scale-event recovers to a consistent fleet.
 """
 
 from __future__ import annotations
@@ -90,6 +107,13 @@ _WORKERS_TOTAL = obs_metrics.gauge(
     "di_fleet_workers_total", "Workers under supervision (not retired)")
 _WORKERS_HEALTHY = obs_metrics.gauge(
     "di_fleet_workers_healthy", "Workers currently probed healthy")
+_PREEMPTIONS = obs_metrics.counter(
+    "di_fleet_preemptions_total",
+    "Workers lost to preemption (expected capacity loss: no circuit "
+    "penalty, immediate replacement)")
+_ORPHANS_REAPED = obs_metrics.counter(
+    "di_fleet_orphans_reaped_total",
+    "Still-alive workers of a dead supervisor killed at startup")
 
 # Retired worker records kept around for /stats & fleet_state.json
 # visibility; older ones are GC'd so a long-lived fleet's daily
@@ -269,7 +293,7 @@ def stub_worker_cmd(worker_id: str, port: int, heartbeat_path: str,
     if sig:
         cmd += ["--weights_signature", str(sig)]
     for key in ("warm_buckets", "delay_ms", "warm_after_s",
-                "crash_after_s", "heartbeat_interval_s"):
+                "crash_after_s", "heartbeat_interval_s", "probs_value"):
         if key in overrides:
             cmd += [f"--{key}", str(overrides[key])]
     return cmd
@@ -306,6 +330,34 @@ class FleetConfig:
     state_dir: str = ""
     # SIGTERM-drain grace before the SIGKILL fallback at stop/retire.
     drain_timeout_s: float = 30.0
+
+
+def load_persisted_state(state_path: str) -> Dict[str, Any]:
+    """Tolerant read of a (possibly previous-life) ``fleet_state.json``:
+    ``{}`` when missing or malformed — recovery must never crash on the
+    state it is recovering from (``cli/fsck.py`` owns quarantine and
+    reporting for malformed state)."""
+    try:
+        with open(state_path) as fh:
+            state = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return state if isinstance(state, dict) else {}
+
+
+def _pid_runs_worker(pid: int) -> bool:
+    """True when ``/proc/<pid>/cmdline`` looks like one of OUR worker
+    processes — the guard that makes startup orphan reaping safe against
+    pid reuse. Conservative: an unreadable/absent cmdline (non-Linux,
+    already-gone process) is False; the worker's own parent-watcher
+    remains the self-draining fallback."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as fh:
+            cmd = fh.read().replace(b"\x00", b" ").decode("utf-8",
+                                                          "replace")
+    except OSError:
+        return False
+    return "deepinteract_tpu" in cmd
 
 
 class _Worker:
@@ -378,6 +430,17 @@ class WorkerSupervisor:
         # contract must still report that supervision degraded during
         # the run — "ok" would otherwise be vacuously true at exit.
         self._circuit_tripped = 0
+        # Expected capacity losses (preempt_worker / fleet.preempt):
+        # counted separately from restarts because they carry no
+        # circuit penalty and say nothing about worker health.
+        self._preemptions = 0
+        self._orphans_reaped = 0
+        # Control-plane records (autoscaler target, version weights)
+        # persisted alongside worker state; see set_extra_state.
+        self._extras: Dict[str, Dict[str, Any]] = {}
+        # Called (old_id, new_id) after a preempted worker's replacement
+        # spawns, so a router can swap its routing slot in place.
+        self.on_replacement: Optional[Callable[[str, str], None]] = None
         self._stop = threading.Event()
         self._persist_lock = threading.Lock()
         self._monitor: Optional[threading.Thread] = None
@@ -388,6 +451,12 @@ class WorkerSupervisor:
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         self.state_path = os.path.join(state_dir, "fleet_state.json")
+        # A previous supervisor life's persisted state, read BEFORE this
+        # life writes anything: kill -9 recovery restores control-plane
+        # extras (autoscale target, version weights) from here, and
+        # start() reaps any of its workers still alive.
+        self._recovered_state: Dict[str, Any] = load_persisted_state(
+            self.state_path)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -399,6 +468,7 @@ class WorkerSupervisor:
             spawn_initial = not self._started
             self._started = True
         if spawn_initial:
+            self._reap_orphans()
             for _ in range(self.cfg.num_workers):
                 self.spawn_worker(self._base_overrides)
         if self._monitor is None:
@@ -423,6 +493,67 @@ class WorkerSupervisor:
             else self.cfg.drain_timeout_s)
         self._persist_state()
         return codes
+
+    def _reap_orphans(self) -> None:
+        """Kill still-alive workers recorded by a PREVIOUS supervisor
+        life in this state_dir. kill -9 of a supervisor cannot drain its
+        children; each worker's parent-watcher self-drains eventually,
+        but recovery must be deterministic and immediate — a restarted
+        supervisor spawning a fresh fleet next to orphans would double
+        capacity and fight over heartbeat files. Guarded by a /proc
+        cmdline check so pid reuse cannot kill an innocent process."""
+        with self._lock:
+            prior = self._recovered_state
+            own_pids = {w.proc.pid for w in self._workers.values()
+                        if w.proc is not None}
+        workers = prior.get("workers")
+        if not isinstance(workers, dict):
+            return
+        for wid, snap in workers.items():
+            if not isinstance(snap, dict):
+                continue
+            pid = snap.get("pid")
+            if (not isinstance(pid, int) or pid <= 0 or pid in own_pids
+                    or snap.get("state") == "retired"):
+                continue
+            if not _pid_runs_worker(pid):
+                continue
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                continue
+            with self._lock:
+                self._orphans_reaped += 1
+            _ORPHANS_REAPED.inc()
+            logger.warning(
+                "fleet: reaped orphaned worker %s (pid %d) left by a "
+                "previous supervisor", wid, pid)
+
+    def recovered_state(self) -> Dict[str, Any]:
+        """The previous supervisor life's persisted fleet_state.json as
+        read at construction ({} on a fresh state_dir): the autoscaler
+        and router restore their control-plane records from here after
+        a kill -9 restart."""
+        with self._lock:
+            return dict(self._recovered_state)
+
+    def set_extra_state(self, key: str, value: Dict[str, Any]) -> None:
+        """Merge a control-plane record (autoscaler target, version
+        weights/shadow config) into ``fleet_state.json`` under ``key``,
+        persisted through the same atomic write as worker state — kill
+        -9 recovery reads one consistent snapshot, never half of a
+        scale event or promotion."""
+        if key in ("workers", "updated_ts", "restarts_total",
+                   "preemptions"):
+            raise ValueError(f"extra-state key {key!r} shadows a core "
+                             "fleet_state field")
+        with self._lock:
+            self._extras[key] = dict(value)
+        self._persist_state()
+
+    def extra_state(self, key: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._extras.get(key, {}))
 
     def drain_many(self, worker_ids: Sequence[str],
                    timeout_s: float) -> Dict[str, Optional[int]]:
@@ -601,6 +732,13 @@ class WorkerSupervisor:
         tests can drive supervision deterministically instead of
         sleeping against the monitor cadence."""
         now = time.monotonic()
+        # Chaos: an injected preemption notice lands at a supervision
+        # tick — the newest routable worker is preempted, exactly like
+        # a spot-capacity reclaim arriving out of band.
+        if faults.fire("fleet.preempt"):
+            victims = self.routable_workers()
+            if victims:
+                self.preempt_worker(victims[-1]["worker_id"])
         with self._lock:
             workers = [w for w in self._workers.values()
                        if w.state not in ("retired",)]
@@ -614,6 +752,11 @@ class WorkerSupervisor:
             rc = proc.poll() if proc is not None else None
             if proc is None or rc is not None:
                 changed |= self._handle_down(w, rc, now)
+                continue
+            if state == "preempted":
+                # Alive and draining itself after the preemption
+                # SIGTERM: keep watching for the exit, but never
+                # probe-reclassify it back to healthy/unhealthy.
                 continue
             to_probe.append(w)
         # Probes run CONCURRENTLY: one black-holed worker burning its
@@ -638,6 +781,37 @@ class WorkerSupervisor:
         """``w``'s process is gone (or never spawned). Classify, maybe
         trip the circuit, maybe respawn."""
         respawn = False
+        replacement_overrides: Optional[Dict[str, Any]] = None
+        with self._lock:
+            if w.state == "preempted":
+                # EXPECTED capacity loss: retire without a circuit
+                # penalty (no restart_times entry, no backoff) and
+                # replace immediately — preemption says nothing about
+                # worker health, and the capacity is wanted back now.
+                w.last_exit_code = rc
+                w.state = "retired"
+                w.last_error = "preempted (expected capacity loss)"
+                self._preemptions += 1
+                replacement_overrides = dict(w.overrides)
+                self._gc_retired_locked()
+        if replacement_overrides is not None:
+            _PREEMPTIONS.inc()
+            logger.warning(
+                "fleet: preempted worker %s exited (rc=%s) — spawning "
+                "replacement immediately", w.worker_id, rc)
+            if not self._stop.is_set():
+                try:
+                    new_id = self.spawn_worker(replacement_overrides)
+                except RuntimeError:
+                    pass  # stop() raced the respawn; drain owns cleanup
+                else:
+                    if self.on_replacement is not None:
+                        try:
+                            self.on_replacement(w.worker_id, new_id)
+                        except Exception:  # noqa: BLE001 - observer hook
+                            logger.exception(
+                                "fleet: on_replacement hook failed")
+            return True
         with self._lock:
             if w.state in ("circuit_open", "spawning", "draining",
                            "retired"):
@@ -729,10 +903,11 @@ class WorkerSupervisor:
                 time.monotonic() - spawned_at)
         changed = False
         with self._lock:
-            if w.state in ("draining", "retired"):
-                # A drain won the race against this probe's network I/O:
-                # a stale success must not resurrect a retired worker
-                # (the next tick would respawn it with the OLD weights).
+            if w.state in ("draining", "retired", "preempted"):
+                # A drain (or preemption notice) won the race against
+                # this probe's network I/O: a stale success must not
+                # resurrect a retired worker (the next tick would
+                # respawn it with the OLD weights).
                 return False
             prev = w.state
             w.heartbeat = hb.status if hb is not None else "disabled"
@@ -848,6 +1023,38 @@ class WorkerSupervisor:
                            _PROBE_FAILURES, _WEDGE_KILLS):
                 family.remove(worker=worker_id)
 
+    def preempt_worker(self, worker_id: str) -> bool:
+        """Deliver a preemption notice: mark the worker ``preempted``
+        (immediately unroutable — ``routable_workers`` only returns
+        ``healthy``) and SIGTERM it so its own drain path finishes
+        in-flight work. When the process exits, :meth:`_handle_down`
+        retires it with NO circuit penalty and spawns a replacement
+        immediately. Returns False when the worker is already on its
+        way out (draining/retired/preempted/circuit_open)."""
+        w = self._get(worker_id)
+        with self._lock:
+            if w.state in ("retired", "draining", "preempted",
+                           "circuit_open"):
+                return False
+            w.state = "preempted"
+            w.last_error = "preemption notice"
+        logger.warning("fleet: %s preempted — SIGTERM sent, replacement "
+                       "spawns on exit", worker_id)
+        self._persist_state()
+        self._update_gauges()
+        if not self._signal(w, signal.SIGTERM):
+            # Delivery failed (fleet.kill chaos / pid surprise): SIGKILL
+            # so the preempted worker cannot linger half-forgotten — the
+            # replacement path only triggers on its exit.
+            with self._lock:
+                proc = w.proc
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        return True
+
     def kill_worker(self, worker_id: str) -> None:
         """SIGKILL (chaos / operator hammer); the monitor's normal
         crash-restart path picks up the corpse."""
@@ -896,6 +1103,8 @@ class WorkerSupervisor:
                 "restarts_total": self._restarts_total,
                 "circuit_open": states.get("circuit_open", 0),
                 "circuit_tripped_total": self._circuit_tripped,
+                "preemptions": self._preemptions,
+                "orphans_reaped": self._orphans_reaped,
                 "state_path": self.state_path,
             }
 
@@ -906,9 +1115,12 @@ class WorkerSupervisor:
             state = {
                 "updated_ts": time.time(),
                 "restarts_total": self._restarts_total,
+                "preemptions": self._preemptions,
                 "workers": {w.worker_id: w.snapshot()
                             for w in self._workers.values()},
             }
+            state.update({key: dict(value)
+                          for key, value in self._extras.items()})
         # Serialized: atomic_write's tmp name is pid-based, so two
         # threads persisting concurrently (monitor tick + a drain
         # thread) would collide on the same tmp file.

@@ -35,10 +35,30 @@ single-engine workers alive; the router:
   new ones; nothing is dropped and no client ever hits a cold compile.
   A replacement that never warms ABORTS the rollover (replacements are
   killed, the old fleet keeps serving) — rollover is all-or-nothing.
+* **serves versions** — rollover's ``weights_signature`` plumbing
+  generalizes from "replace the fleet" to "run several checkpoint
+  versions concurrently". A request pins a version with the
+  ``X-DI-Version`` header (or a ``version`` field in a JSON body) and
+  is then routed — including every failover retry — ONLY within that
+  version's workers; a pinned version with zero healthy workers answers
+  503 + ``Retry-After``, never a silent cross-version fallback.
+  Unpinned traffic is split by smooth weighted round-robin over the
+  canary weights configured via ``POST /admin/versions``, which also
+  arms **shadow traffic**: a sampled fraction of ``/predict`` requests
+  is mirrored (off the critical path) to the candidate version, the
+  outputs are compared, and every comparison is appended to a JSONL
+  agreement ledger written atomically through
+  ``robustness/artifacts.py``. ``POST /admin/promote`` shifts routing
+  weight to the candidate ONLY when the measured agreement clears the
+  configured bar (min samples + min agreement rate) and refuses — fleet
+  untouched — otherwise. Version weights, shadow config, and promotion
+  count persist through the supervisor's ``fleet_state.json`` so a
+  kill -9 of the whole control plane drops no version pins.
 
 The rollover response and the router's final stdout line (printed by
 ``cli/serve.py``) share the machine-readable ``fleet/v1`` contract
-(``tools/check_cli_contract.py`` kind ``fleet``).
+(``tools/check_cli_contract.py`` kind ``fleet``); ``/admin/versions``
+answers the ``versions/v1`` contract.
 """
 
 from __future__ import annotations
@@ -47,6 +67,7 @@ import dataclasses
 import http.client
 import json
 import logging
+import os
 import re
 import signal
 import threading
@@ -57,6 +78,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from deepinteract_tpu.obs import expfmt
 from deepinteract_tpu.obs import metrics as obs_metrics
+from deepinteract_tpu.robustness import artifacts
 from deepinteract_tpu.robustness.preemption import PreemptionGuard
 from deepinteract_tpu.serving.admission import Deadline
 from deepinteract_tpu.serving.fleet import (
@@ -78,6 +100,21 @@ _FAILOVERS = obs_metrics.counter(
     labelnames=("reason",))
 _ROLLOVERS = obs_metrics.counter(
     "di_fleet_rollovers_total", "Warm rollovers", labelnames=("outcome",))
+_VERSION_PICKS = obs_metrics.counter(
+    "di_fleet_version_picks_total",
+    "Requests assigned to a checkpoint version (pinned or canary split)",
+    labelnames=("version", "mode"))
+_SHADOW = obs_metrics.counter(
+    "di_fleet_shadow_total",
+    "Shadow-mirrored requests by comparison outcome",
+    labelnames=("outcome",))
+_PROMOTIONS = obs_metrics.counter(
+    "di_fleet_promotions_total", "Version promotion attempts",
+    labelnames=("outcome",))
+_REQ_LATENCY = obs_metrics.histogram(
+    "di_router_request_seconds",
+    "Router-side end-to-end proxy latency, failovers included — the "
+    "autoscaler's p99 signal")
 
 
 class RolloverFailed(RuntimeError):
@@ -89,6 +126,21 @@ class RolloverBusy(RolloverFailed):
     """A rollover is already in progress (HTTP 409 — retry later). A
     TYPE, not a message substring, so rewording can't break the status
     mapping."""
+
+
+class VersionError(ValueError):
+    """Malformed ``/admin/versions`` / ``/admin/promote`` request
+    (HTTP 400); the routing state is untouched."""
+
+
+class PromotionRefused(RuntimeError):
+    """A promotion did not clear the measured-agreement bar (HTTP 409).
+    The fleet's routing weights are UNTOUCHED — a candidate earns
+    traffic by evidence, not by asking twice."""
+
+    def __init__(self, msg: str, stats: Optional[Dict[str, Any]] = None):
+        super().__init__(msg)
+        self.stats = dict(stats or {})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,6 +189,19 @@ class FleetRouter:
         # real rollover spuriously 409.
         self._rollover_lock = threading.Lock()
         self._rollover_active = False
+        # Multi-version routing state (all under _lock). Empty weights =
+        # legacy single-pool behaviour: every active worker is one pool.
+        self._version_weights: Dict[str, float] = {}
+        self._version_rr: Dict[str, float] = {}
+        self._shadow: Optional[Dict[str, Any]] = None
+        self._shadow_counter = 0
+        self._shadow_samples = 0
+        self._shadow_agree = 0
+        self._shadow_ledger: List[Dict[str, Any]] = []
+        self._promotions = 0
+        # Preemption replacements carry a NEW worker id; the supervisor
+        # tells us so the routing table swaps old->new in place.
+        supervisor.on_replacement = self._on_replacement
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -149,7 +214,8 @@ class FleetRouter:
                            extra: Optional[Dict[str, str]] = None) -> None:
                 _ROUTED.inc(endpoint=endpoint_label(
                     self.path, ("/predict", "/screen", "/healthz",
-                                "/stats", "/metrics", "/admin/rollover")),
+                                "/stats", "/metrics", "/admin/rollover",
+                                "/admin/versions", "/admin/promote")),
                     status=str(code))
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
@@ -170,6 +236,8 @@ class FleetRouter:
                     self._send_json(200, router.health())
                 elif route == "/stats":
                     self._send_json(200, router.stats())
+                elif route == "/admin/versions":
+                    self._send_json(200, router.versions_record())
                 elif route == "/metrics":
                     self._send_body(200, router.metrics_text().encode(),
                                     expfmt.CONTENT_TYPE)
@@ -182,6 +250,12 @@ class FleetRouter:
                 body = self.rfile.read(length)
                 if route == "/admin/rollover":
                     self._do_rollover(body)
+                    return
+                if route == "/admin/versions":
+                    self._do_versions(body)
+                    return
+                if route == "/admin/promote":
+                    self._do_promote(body)
                     return
                 if route not in ("/predict", "/screen"):
                     self._send_json(404, {"error": f"no route {route}"})
@@ -199,11 +273,59 @@ class FleetRouter:
                     content_type=self.headers.get(
                         "Content-Type", "application/octet-stream"),
                     bucket_hint=self.headers.get("X-DI-Bucket"),
-                    deadline=deadline)
+                    deadline=deadline,
+                    version=self._version_pin(body))
                 self._send_body(status, out,
                                 headers.pop("Content-Type",
                                             "application/json"),
                                 extra=headers)
+
+            def _version_pin(self, body: bytes) -> Optional[str]:
+                """The request's pinned version: ``X-DI-Version`` header,
+                else a ``version`` field in a JSON body. The body parse
+                only runs when the raw bytes can contain the key, so
+                unpinned hot-path requests never pay a JSON decode."""
+                pin = self.headers.get("X-DI-Version")
+                if pin is not None:
+                    return pin
+                if body and b'"version"' in body:
+                    try:
+                        payload = json.loads(body.decode())
+                    except (ValueError, UnicodeDecodeError):
+                        return None  # the worker answers 400 for itself
+                    if isinstance(payload, dict) and \
+                            payload.get("version") is not None:
+                        return str(payload["version"])
+                return None
+
+            def _do_versions(self, body: bytes) -> None:
+                try:
+                    spec = json.loads(body.decode()) if body else {}
+                    if not isinstance(spec, dict):
+                        raise VersionError(
+                            "versions body must be a JSON object")
+                    record = router.set_versions(spec)
+                except (VersionError, ValueError) as exc:
+                    self._send_json(400, {"error": str(exc), "ok": False})
+                    return
+                self._send_json(200, record)
+
+            def _do_promote(self, body: bytes) -> None:
+                try:
+                    spec = json.loads(body.decode()) if body else {}
+                    if not isinstance(spec, dict):
+                        raise VersionError(
+                            "promote body must be a JSON object")
+                    record = router.promote(spec)
+                except PromotionRefused as exc:
+                    self._send_json(409, {
+                        **router.versions_record(), "ok": False,
+                        "error": str(exc), "refused": exc.stats})
+                    return
+                except (VersionError, ValueError) as exc:
+                    self._send_json(400, {"error": str(exc), "ok": False})
+                    return
+                self._send_json(200, record)
 
             def _deadline(self) -> Optional[Deadline]:
                 hdr = self.headers.get("X-Request-Deadline-Ms")
@@ -259,6 +381,7 @@ class FleetRouter:
                 self._active = [w["worker_id"]
                                 for w in self.sup.worker_infos()
                                 if w["state"] != "retired"]
+        self._restore_versions()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="fleet-router",
             daemon=True)
@@ -322,13 +445,28 @@ class FleetRouter:
 
     # -- routing -----------------------------------------------------------
 
-    def _pick_sequence(self, bucket_hint: Optional[str]) -> List[str]:
+    def _pick_sequence(self, bucket_hint: Optional[str],
+                       version: Optional[str] = None) -> List[str]:
         """Failover-ordered candidate workers: every routable worker at
         most once, starting from the bucket-affine (or round-robin)
-        choice."""
-        routable = {w["worker_id"] for w in self.sup.routable_workers()}
+        choice. A pinned ``version`` restricts candidates — and every
+        failover retry — to that version's workers; zero healthy pinned
+        workers yields an EMPTY sequence (the caller answers 503 +
+        Retry-After), never a cross-version fallback. Unpinned requests
+        under configured canary weights choose a version by smooth
+        weighted round-robin and order its workers first; other
+        versions' workers stay as the failover tail, so an unpinned
+        request is never dropped while ANY version is healthy."""
+        sig_of = {
+            w["worker_id"]:
+                str((w.get("health") or {}).get("weights_signature"))
+            for w in self.sup.routable_workers()}
+        chosen: Optional[str] = None
         with self._lock:
-            candidates = [wid for wid in self._active if wid in routable]
+            candidates = [wid for wid in self._active if wid in sig_of]
+            if version is not None:
+                candidates = [wid for wid in candidates
+                              if sig_of[wid] == version]
             if not candidates:
                 return []
             if bucket_hint:
@@ -336,15 +474,64 @@ class FleetRouter:
             else:
                 start = self._rr % len(candidates)
                 self._rr += 1
-            return candidates[start:] + candidates[:start]
+            sequence = candidates[start:] + candidates[:start]
+            if version is None and self._version_weights:
+                chosen = self._choose_version_locked(
+                    {sig_of[wid] for wid in candidates})
+                if chosen is not None:
+                    sequence = (
+                        [w for w in sequence if sig_of[w] == chosen]
+                        + [w for w in sequence if sig_of[w] != chosen])
+        picked = version if version is not None else chosen
+        if picked is not None:
+            _VERSION_PICKS.inc(version=picked,
+                               mode="pinned" if version else "weighted")
+        return sequence
+
+    def _choose_version_locked(self, available: set) -> Optional[str]:
+        """Smooth weighted round-robin (the nginx algorithm) over the
+        configured weights, restricted to versions that have a routable
+        worker RIGHT NOW — a weighted-but-down version never swallows
+        picks. Caller holds ``_lock``."""
+        weights = {v: w for v, w in self._version_weights.items()  # di: allow[lock-discipline] caller holds _lock
+                   if v in available and w > 0}
+        if not weights:
+            return None
+        total = sum(weights.values())
+        for v, w in weights.items():
+            self._version_rr[v] = self._version_rr.get(v, 0.0) + w  # di: allow[lock-discipline] caller holds _lock
+        best = max(sorted(weights), key=lambda v: self._version_rr[v])  # di: allow[lock-discipline] caller holds _lock
+        self._version_rr[best] -= total  # di: allow[lock-discipline] caller holds _lock
+        return best
 
     def proxy(self, method: str, path: str, body: bytes,
               content_type: str = "application/json",
               bucket_hint: Optional[str] = None,
               deadline: Optional[Deadline] = None,
+              version: Optional[str] = None,
               ) -> Tuple[int, bytes, Dict[str, str]]:
-        """Forward one idempotent request, failing over across siblings.
-        Returns (status, body, response headers). After exhausting the
+        """Forward one idempotent request, failing over across siblings
+        (within the pinned ``version``'s workers when one is given).
+        Returns (status, body, response headers); observes the router
+        latency histogram (the autoscaler's p99 signal) and mirrors a
+        sampled fraction of successful unpinned ``/predict`` requests to
+        the shadow candidate off the critical path."""
+        t0 = time.monotonic()
+        status, out, headers = self._route(
+            method, path, body, content_type, bucket_hint, deadline,
+            version)
+        _REQ_LATENCY.observe(time.monotonic() - t0)
+        if version is not None:
+            headers.setdefault("X-DI-Version", version)
+        elif status == 200:
+            self._maybe_shadow(method, path, body, content_type, out)
+        return status, out, headers
+
+    def _route(self, method: str, path: str, body: bytes,
+               content_type: str, bucket_hint: Optional[str],
+               deadline: Optional[Deadline], version: Optional[str],
+               ) -> Tuple[int, bytes, Dict[str, str]]:
+        """The failover loop behind :meth:`proxy`. After exhausting the
         candidate list, ONE re-pick: a request that raced a rollover's
         routing swap may have frozen the OLD (now-draining) workers as
         its candidates while warm replacements exist — the second pick
@@ -355,10 +542,10 @@ class FleetRouter:
         no-healthy-worker 503."""
         attempts: List[str] = []
         last_500: List[Tuple[int, bytes, Dict[str, str]]] = []
-        sequence = self._pick_sequence(bucket_hint)
+        sequence = self._pick_sequence(bucket_hint, version)
         for round_no in (1, 2):
             if round_no == 2:
-                refreshed = self._pick_sequence(bucket_hint)
+                refreshed = self._pick_sequence(bucket_hint, version)
                 sequence = [wid for wid in refreshed
                             if wid not in attempts]
                 if not sequence:
@@ -371,8 +558,12 @@ class FleetRouter:
         if last_500:
             return self._count(*last_500[-1])
         retry_after = 1.0
+        pool = ("no healthy worker available" if version is None
+                else f"no healthy worker for version {version!r} "
+                     "(pinned requests never fall back to another "
+                     "version)")
         return self._count(503, json.dumps({
-            "error": "no healthy worker available"
+            "error": pool
                      + (f" (attempted {attempts})" if attempts else ""),
             "retry_after_s": retry_after,
         }).encode(), {"Retry-After": str(int(retry_after))})
@@ -574,6 +765,301 @@ class FleetRouter:
         return all(any(str(label).startswith(req) for label in warm)
                    for req in self.cfg.required_warm_buckets)
 
+    # -- multi-version serving ---------------------------------------------
+
+    def adopt_worker(self, worker_id: str) -> None:
+        """Add a (warm) worker to the routing table — the autoscaler's
+        scale-up entry after its replacement finished warming."""
+        with self._lock:
+            if worker_id not in self._active:
+                self._active.append(worker_id)
+
+    def release_worker(self, worker_id: str) -> None:
+        """Remove a worker from the routing table BEFORE draining it —
+        new picks stop immediately; in-flight requests finish or fail
+        over."""
+        with self._lock:
+            if worker_id in self._active:
+                self._active.remove(worker_id)
+
+    def _on_replacement(self, old_id: str, new_id: str) -> None:
+        """Supervisor callback: a preempted worker's replacement swaps
+        into the old worker's routing slot (same overrides, same
+        version) — capacity recovers without operator action."""
+        with self._lock:
+            if old_id in self._active:
+                self._active[self._active.index(old_id)] = new_id
+
+    def request_p99_ms(self) -> float:
+        """Router-side p99 latency in ms (0.0 before any request) — one
+        of the autoscaler's inputs."""
+        return _REQ_LATENCY.percentile(99) * 1e3
+
+    def set_versions(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply a ``POST /admin/versions`` spec: ``weights`` (canary
+        split, ``{signature: weight}``) and/or ``shadow`` (mirror
+        config: ``candidate``, ``fraction``, optional ``tolerance`` /
+        ``min_agreement`` / ``min_samples`` / ``ledger_path``; null
+        disarms). Validates fully BEFORE touching state, persists
+        through the supervisor's fleet_state.json, and returns the
+        ``versions/v1`` record."""
+        weights = None
+        if spec.get("weights") is not None:
+            weights = self._parse_weights(spec["weights"])
+        shadow = None
+        if spec.get("shadow") is not None:
+            shadow = self._parse_shadow(spec["shadow"])
+        with self._lock:
+            if weights is not None:
+                self._version_weights = weights
+                self._version_rr = {}
+            if "shadow" in spec:
+                old_candidate = (self._shadow or {}).get("candidate")
+                self._shadow = shadow
+                if shadow is None or \
+                        shadow["candidate"] != old_candidate:
+                    # A new (or cleared) candidate starts its agreement
+                    # evidence from zero — stale ledgers don't promote.
+                    self._shadow_counter = 0
+                    self._shadow_samples = 0
+                    self._shadow_agree = 0
+                    self._shadow_ledger = []
+        self._persist_versions()
+        logger.info("versions: weights=%s shadow=%s",
+                    weights if weights is not None else "<unchanged>",
+                    shadow if "shadow" in spec else "<unchanged>")
+        return self.versions_record()
+
+    @staticmethod
+    def _parse_weights(raw: Any) -> Dict[str, float]:
+        if not isinstance(raw, dict):
+            raise VersionError("weights must be an object "
+                               "{signature: weight}")
+        weights: Dict[str, float] = {}
+        for sig, value in raw.items():
+            try:
+                w = float(value)
+            except (TypeError, ValueError):
+                raise VersionError(
+                    f"weight for {sig!r} must be a number, got "
+                    f"{value!r}")
+            if w < 0:
+                raise VersionError(f"weight for {sig!r} must be >= 0")
+            if w > 0:
+                weights[str(sig)] = w
+        if raw and not weights:
+            raise VersionError("at least one weight must be > 0")
+        return weights
+
+    def _parse_shadow(self, raw: Any) -> Dict[str, Any]:
+        if not isinstance(raw, dict) or not raw.get("candidate"):
+            raise VersionError(
+                "shadow must be an object with a 'candidate' signature")
+        candidate = str(raw["candidate"])
+        try:
+            fraction = float(raw.get("fraction", 1.0))
+        except (TypeError, ValueError):
+            raise VersionError("shadow fraction must be a number")
+        if not 0 < fraction <= 1:
+            raise VersionError("shadow fraction must be in (0, 1]")
+        default_ledger = os.path.join(
+            os.path.dirname(self.sup.state_path),
+            f"agreement_{candidate}.jsonl")
+        return {
+            "candidate": candidate,
+            "fraction": fraction,
+            "tolerance": float(raw.get("tolerance", 1e-6)),
+            "min_agreement": float(raw.get("min_agreement", 0.98)),
+            "min_samples": int(raw.get("min_samples", 10)),
+            "ledger_path": str(raw.get("ledger_path", default_ledger)),
+        }
+
+    def promote(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """``POST /admin/promote``: shift routing weight to the shadow
+        candidate ONLY on measured agreement. Raises
+        :class:`PromotionRefused` (fleet untouched) when the evidence
+        does not clear the bar, :class:`VersionError` when there is no
+        candidate to judge."""
+        with self._lock:
+            shadow = dict(self._shadow) if self._shadow else {}
+            samples, agree = self._shadow_samples, self._shadow_agree
+        candidate = spec.get("candidate") or shadow.get("candidate")
+        if not candidate:
+            raise VersionError("no promotion candidate: pass "
+                               "'candidate' or arm shadow traffic first")
+        min_agreement = float(
+            spec.get("min_agreement",
+                     shadow.get("min_agreement", 0.98)))
+        min_samples = int(
+            spec.get("min_samples", shadow.get("min_samples", 10)))
+        rate = (agree / samples) if samples else 0.0
+        stats = {"candidate": candidate, "samples": samples,
+                 "agreements": agree,
+                 "agreement_rate": round(rate, 6),
+                 "min_agreement": min_agreement,
+                 "min_samples": min_samples}
+        if samples < min_samples or rate < min_agreement:
+            _PROMOTIONS.inc(outcome="refused")
+            raise PromotionRefused(
+                f"promotion refused: {samples} sample(s) at "
+                f"{rate:.4f} agreement vs bar of >= {min_samples} "
+                f"samples and >= {min_agreement:.4f} — routing weights "
+                "untouched", stats=stats)
+        weights = self._parse_weights(
+            spec.get("weights") or {candidate: 1.0})
+        with self._lock:
+            self._version_weights = weights
+            self._version_rr = {}
+            self._shadow = None
+            self._promotions += 1
+        _PROMOTIONS.inc(outcome="ok")
+        self._persist_versions()
+        logger.info("promotion: %s -> weights %s (%s)", candidate,
+                    weights, stats)
+        return {**self.versions_record(), "promoted": candidate,
+                "evidence": stats}
+
+    def versions_record(self) -> Dict[str, Any]:
+        """The ``versions/v1`` machine-readable record (the
+        ``/admin/versions`` response and ``cli/serve.py --versions``
+        final line)."""
+        by_version: Dict[str, int] = {}
+        for w in self.sup.routable_workers():
+            sig = str((w.get("health") or {}).get("weights_signature"))
+            by_version[sig] = by_version.get(sig, 0) + 1
+        with self._lock:
+            weights = dict(self._version_weights)
+            shadow = dict(self._shadow) if self._shadow else None
+            samples, agree = self._shadow_samples, self._shadow_agree
+            promotions = self._promotions
+        return {
+            "schema": "versions/v1",
+            "metric": "fleet_active_versions",
+            "value": float(len(by_version)),
+            "unit": "versions",
+            "ok": True,
+            "weights": weights,
+            "workers_by_version": by_version,
+            "shadow": shadow,
+            "shadow_samples": samples,
+            "shadow_agreement": (round(agree / samples, 6)
+                                 if samples else None),
+            "promotions": promotions,
+        }
+
+    def _persist_versions(self) -> None:
+        with self._lock:
+            record = {
+                "weights": dict(self._version_weights),
+                "shadow": dict(self._shadow) if self._shadow else None,
+                "promotions": self._promotions,
+            }
+        try:
+            self.sup.set_extra_state("versions", record)
+        except (OSError, ValueError) as exc:
+            logger.warning("versions: persist failed: %s", exc)
+
+    def _restore_versions(self) -> None:
+        """Recover version weights / shadow config / promotion count
+        from a dead supervisor's fleet_state.json — kill -9 of the
+        control plane drops no version pins."""
+        record = self.sup.recovered_state().get("versions")
+        if not isinstance(record, dict):
+            return
+        weights = record.get("weights")
+        shadow = record.get("shadow")
+        with self._lock:
+            if isinstance(weights, dict):
+                restored: Dict[str, float] = {}
+                for sig, value in weights.items():
+                    if isinstance(value, (int, float)) and value > 0:
+                        restored[str(sig)] = float(value)
+                self._version_weights = restored
+                self._version_rr = {}
+            if isinstance(shadow, dict) and shadow.get("candidate"):
+                self._shadow = shadow
+            promotions = record.get("promotions")
+            if isinstance(promotions, int):
+                self._promotions = promotions
+        logger.info("versions: restored from fleet_state.json: %s",
+                    record)
+        self._persist_versions()
+
+    def _maybe_shadow(self, method: str, path: str, body: bytes,
+                      content_type: str, primary_out: bytes) -> None:
+        """Counter-based deterministic sampling: request n is mirrored
+        iff floor(n*f) advanced — exactly fraction f of requests, no
+        RNG. The mirror runs on its own daemon thread; the client's
+        response already left."""
+        if path.partition("?")[0] != "/predict":
+            return
+        with self._lock:
+            shadow = self._shadow
+            if not shadow:
+                return
+            self._shadow_counter += 1
+            n, f = self._shadow_counter, shadow["fraction"]
+            if int(n * f) == int((n - 1) * f):
+                return
+            shadow = dict(shadow)
+        threading.Thread(
+            target=self._shadow_one,
+            args=(shadow, method, path, body, content_type, primary_out),
+            name="shadow-mirror", daemon=True).start()
+
+    def _shadow_one(self, shadow: Dict[str, Any], method: str, path: str,
+                    body: bytes, content_type: str,
+                    primary_out: bytes) -> None:
+        candidate = shadow["candidate"]
+        entry: Dict[str, Any] = {"ts": round(time.time(), 3),
+                                 "path": path, "candidate": candidate}
+        try:
+            sequence = self._pick_sequence(None, version=candidate)
+            if not sequence:
+                entry["outcome"] = "no_worker"
+                _SHADOW.inc(outcome="no_worker")
+            else:
+                worker_id = sequence[0]
+                host, port = self.sup.endpoint(worker_id)
+                status, out, _ = self._attempt(
+                    host, port, method, path, body, content_type, None,
+                    self.cfg.proxy_timeout_s)
+                entry["shadow_worker"] = worker_id
+                if status != 200:
+                    entry.update(outcome="error", status=status)
+                    _SHADOW.inc(outcome="error")
+                else:
+                    agreed, diff = _prediction_agreement(
+                        primary_out, out, shadow["tolerance"])
+                    entry["outcome"] = "agree" if agreed else "disagree"
+                    if diff is not None:
+                        entry["max_abs_diff"] = diff
+                    _SHADOW.inc(outcome=entry["outcome"])
+                    with self._lock:
+                        self._shadow_samples += 1
+                        self._shadow_agree += int(agreed)
+        except Exception as exc:  # noqa: BLE001 - shadow is best-effort
+            entry.update(outcome="error", error=str(exc))
+            _SHADOW.inc(outcome="error")
+        self._append_ledger(shadow["ledger_path"], entry)
+
+    def _append_ledger(self, path: str, entry: Dict[str, Any]) -> None:
+        """Append to the in-memory ledger and rewrite the WHOLE JSONL
+        atomically (artifact + integrity sidecar): a reader — fsck, the
+        promotion rule, an operator's tail — sees a complete, verifiable
+        ledger or the previous one, never a torn line."""
+        with self._lock:
+            self._shadow_ledger.append(entry)
+            data = "".join(json.dumps(e, sort_keys=True) + "\n"
+                           for e in self._shadow_ledger)
+            entries = len(self._shadow_ledger)
+        try:
+            artifacts.atomic_write_artifact(
+                path, data, "agreement_ledger",
+                extra={"entries": entries})
+        except OSError as exc:
+            logger.warning("shadow: ledger write failed: %s", exc)
+
     # -- observability -----------------------------------------------------
 
     def health(self) -> Dict[str, Any]:
@@ -586,6 +1072,8 @@ class FleetRouter:
                   else "ok" if len(healthy) == len(active) else "degraded")
         with self._lock:
             rollover_busy = self._rollover_active
+            version_weights = dict(self._version_weights)
+            shadow_candidate = (self._shadow or {}).get("candidate")
         return {
             "status": status,
             "role": "fleet-router",
@@ -596,6 +1084,8 @@ class FleetRouter:
             "weights_signatures": sorted(
                 {str(w["health"].get("weights_signature"))
                  for w in healthy if w.get("health")}),
+            "version_weights": version_weights,
+            "shadow_candidate": shadow_candidate,
         }
 
     def stats(self) -> Dict[str, Any]:
@@ -607,6 +1097,9 @@ class FleetRouter:
                 "rollovers": self._rollovers,
                 "active_workers": list(self._active),
                 "draining": self._draining.is_set(),
+                "version_weights": dict(self._version_weights),
+                "shadow_samples": self._shadow_samples,
+                "promotions": self._promotions,
             }
         return {"router": router, "fleet": self.sup.stats(),
                 "workers": worker_stats}
@@ -670,6 +1163,10 @@ class FleetRouter:
         sup = self.sup.stats()
         states = sup["states"]
         active = sum(n for state, n in states.items() if state != "retired")
+        versions = len({
+            str((w.get("health") or {}).get("weights_signature"))
+            for w in sup["workers"].values()
+            if w["state"] == "healthy"})
         with self._lock:
             routed, failovers, rollovers = (
                 self._routed, self._failovers, self._rollovers)
@@ -691,8 +1188,56 @@ class FleetRouter:
             "rollovers": rollovers,
             "failovers": failovers,
             "routed": routed,
+            "preemptions": sup["preemptions"],
+            "versions": versions,
             "state_path": sup["state_path"],
         }
+
+
+# ---------------------------------------------------------------------------
+# Shadow-output comparison
+# ---------------------------------------------------------------------------
+
+
+def _flatten(value: Any) -> Optional[List[float]]:
+    """Nested number lists -> flat float list; None when the structure
+    holds anything that is not a number or a list."""
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        return [float(value)]
+    if isinstance(value, list):
+        out: List[float] = []
+        for item in value:
+            flat = _flatten(item)
+            if flat is None:
+                return None
+            out.extend(flat)
+        return out
+    return None
+
+
+def _prediction_agreement(primary: bytes, shadow: bytes,
+                          tolerance: float,
+                          ) -> Tuple[bool, Optional[float]]:
+    """Compare two /predict response bodies on ``contact_probs``:
+    (agreed, max abs elementwise diff). Structural mismatch (missing
+    key, different shape, non-JSON) is a DISAGREEMENT with diff None —
+    a candidate that changes the response shape must not promote."""
+    try:
+        a = json.loads(primary.decode())
+        b = json.loads(shadow.decode())
+    except (ValueError, UnicodeDecodeError):
+        return False, None
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return False, None
+    flat_a = _flatten(a.get("contact_probs"))
+    flat_b = _flatten(b.get("contact_probs"))
+    if flat_a is None or flat_b is None or len(flat_a) != len(flat_b):
+        return False, None
+    diff = max((abs(x - y) for x, y in zip(flat_a, flat_b)),
+               default=0.0)
+    return diff <= tolerance, diff
 
 
 # ---------------------------------------------------------------------------

@@ -58,11 +58,15 @@ class StubWorker:
     def __init__(self, worker_id: str, weights_signature: str,
                  warm_buckets: List[str], delay_ms: float,
                  warm_after_s: float, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, probs_value: float = 0.5):
         self.worker_id = worker_id
         self.weights_signature = weights_signature
         self.configured_buckets = list(warm_buckets)
         self.delay_s = max(0.0, float(delay_ms)) / 1e3
+        # The single fake prediction value: two stubs with different
+        # probs_value disagree deterministically — the shadow-traffic
+        # agreement ledger's test knob.
+        self.probs_value = float(probs_value)
         self._warm_at = time.monotonic() + max(0.0, float(warm_after_s))
         self._started = time.time()
         self._draining = threading.Event()
@@ -145,7 +149,7 @@ class StubWorker:
                         "n1": 1, "n2": 1, "bucket": [64, 64],
                         "cached": False, "coalesced": 1,
                         "latency_ms": worker.delay_s * 1e3,
-                        "contact_probs": [[0.5]],
+                        "contact_probs": [[worker.probs_value]],
                         "worker_id": worker.worker_id,
                         "weights_signature": worker.weights_signature,
                     })
@@ -166,6 +170,8 @@ class StubWorker:
 
     def healthz(self) -> Dict:
         warm = self.warm
+        with self._lock:
+            inflight = self._inflight
         return {
             "status": ("draining" if self._draining.is_set()
                        else "ok" if warm else "warming"),
@@ -174,6 +180,9 @@ class StubWorker:
             "weights_signature": self.weights_signature,
             "warm_buckets": list(self.configured_buckets) if warm else [],
             "worker_id": self.worker_id,
+            # Queue-depth signal: the supervisor's probes cache this in
+            # the worker snapshot, where the autoscaler reads it.
+            "inflight": inflight,
         }
 
     def stats(self) -> Dict:
@@ -212,6 +221,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comma list of compile-inventory labels "
                              "healthz reports once warm")
     parser.add_argument("--delay_ms", type=float, default=10.0)
+    parser.add_argument("--probs_value", type=float, default=0.5,
+                        help="the stub's constant contact probability — "
+                             "distinct values make two versions disagree "
+                             "deterministically (shadow-traffic tests)")
     parser.add_argument("--warm_after_s", type=float, default=0.0)
     parser.add_argument("--crash_after_s", type=float, default=0.0,
                         help="> 0: hard-exit (os._exit 3) after this many "
@@ -227,7 +240,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     worker = StubWorker(
         args.worker_id, args.weights_signature,
         [b for b in args.warm_buckets.split(",") if b.strip()],
-        args.delay_ms, args.warm_after_s, host=args.host, port=args.port)
+        args.delay_ms, args.warm_after_s, host=args.host, port=args.port,
+        probs_value=args.probs_value)
     hb = None
     if args.heartbeat_file:
         hb = Heartbeat(args.heartbeat_file,

@@ -558,9 +558,10 @@ class Trainer:
                 check_host_agreement(run[0])
             if len(run) < max(k, 2):
                 for hb in run:
-                    out = self._eval_step(state, self._device_batch(hb))
-                    consume(hb, host_local_array(out["probs"]),
-                            host_local_array(out["logits"]))
+                    padded, real_b = self._pad_to_mesh(hb)
+                    out = self._eval_step(state, self._device_batch(padded))
+                    consume(hb, host_local_array(out["probs"])[:real_b],
+                            host_local_array(out["logits"])[:real_b])
             else:
                 from deepinteract_tpu.training.steps import (
                     pack_tree,
@@ -571,13 +572,22 @@ class Trainer:
                     # Packed upload: one buffer per dtype (see fit()).
                     buffers, spec = pack_tree(stack_microbatches(run))
                     out = self._multi_eval_packed(state, buffers, spec)
+                    real_b = None
                 else:
+                    # Same-shape runs share one batch size, so one pad
+                    # amount covers the whole [K, B, ...] stack.
+                    padded_run = []
+                    real_b = None
+                    for hb in run:
+                        padded, real_b = self._pad_to_mesh(hb)
+                        padded_run.append(padded)
                     out = self._multi_eval(
-                        state, self._device_stacked(stack_microbatches(run)))
+                        state,
+                        self._device_stacked(stack_microbatches(padded_run)))
                 probs = host_local_array(out["probs"])
                 logits = host_local_array(out["logits"])
                 for j, hb in enumerate(run):
-                    consume(hb, probs[j], logits[j])
+                    consume(hb, probs[j][:real_b], logits[j][:real_b])
         agg = M.aggregate_median(per_complex)
         agg = {f"{stage}_{k}" if not k.startswith("med_") else f"med_{stage}_{k[4:]}": v
                for k, v in agg.items()}
@@ -1549,6 +1559,37 @@ class Trainer:
             flush(pending)
         return state
 
+    def _pad_to_mesh(self, host_batch: PairedComplex):
+        """Pad an EVAL batch's leading axis up to mesh divisibility by
+        repeating the last complex, returning ``(padded, real_b)``.
+
+        A val/test split whose (global) batch does not divide the mesh's
+        data axis — the canonical case is a 1-complex split on a 4-way
+        mesh — must pad, not crash in ``device_put``. Callers slice the
+        step outputs back to ``real_b`` before metrics, so the clones
+        never contaminate the reported numbers. Train batches stay the
+        loader's contract (data/pipeline.py sizes them to the mesh);
+        this affordance is eval-only."""
+        if self.mesh is None:
+            return host_batch, None
+        from deepinteract_tpu.parallel.mesh import DATA_AXIS
+
+        data_size = int(self.mesh.shape.get(DATA_AXIS, 1))
+        real_b = int(np.shape(jax.tree_util.tree_leaves(host_batch)[0])[0])
+        procs = jax.process_count()
+        target = real_b
+        while (target * procs) % data_size != 0:
+            target += 1
+        if target == real_b:
+            return host_batch, real_b
+        pad = target - real_b
+        padded = jax.tree_util.tree_map(
+            lambda x: np.concatenate(
+                [np.asarray(x),
+                 np.repeat(np.asarray(x)[-1:], pad, axis=0)], axis=0),
+            host_batch)
+        return padded, real_b
+
     def _device_batch(self, batch: PairedComplex) -> PairedComplex:
         if self.mesh is not None:
             from deepinteract_tpu.parallel.mesh import shard_batch
@@ -1592,11 +1633,12 @@ class Trainer:
         host_batch = next(iter(_iter_data(val_data, 0)), None)
         if host_batch is None:
             return
-        batch = self._device_batch(host_batch)
+        padded, real_b = self._pad_to_mesh(host_batch)
+        batch = self._device_batch(padded)
         out = self._eval_step(state, batch)
         if self.metric_writer is None:
             return  # non-primary host: participated in the collective only
-        probs_full = host_local_array(out["probs"])
+        probs_full = host_local_array(out["probs"])[:real_b]
         expected = np.asarray(host_batch.contact_map).shape[:3]
         if tuple(probs_full.shape[:3]) != expected:
             raise ValueError(

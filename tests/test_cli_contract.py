@@ -72,7 +72,7 @@ def test_registered_kinds_cover_every_contract_cli():
     whose final line is a machine contract has a registered kind, so a
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
-            "perf_regression", "lint", "fsck", "fleet",
+            "perf_regression", "lint", "fsck", "fleet", "versions",
             "train_supervise", "sustained"} <= set(CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
@@ -201,6 +201,35 @@ def test_fleet_kind_matches_real_router_emission(tmp_path, capsys):
     # cleanly (workers = still-supervised count), nothing crashed.
     assert rec["ok"] is True and rec["workers"] == 0
     assert rec["restarts"] == 0 and rec["rollovers"] == 0
+    # ISSUE-16 keys ride the same record: no preemption happened, and
+    # the drained fleet serves zero live versions.
+    assert rec["preemptions"] == 0 and rec["versions"] == 0
+
+
+def test_versions_kind_matches_real_router_emission(tmp_path):
+    """The versions/v1 contract is validated against the REAL record
+    builder every ``GET /admin/versions`` response (and ``cli.serve
+    --versions``) comes from — FleetRouter.versions_record over a real
+    supervisor, no processes spawned."""
+    from deepinteract_tpu.serving.fleet import (
+        FleetConfig,
+        WorkerSupervisor,
+        stub_worker_cmd,
+    )
+    from deepinteract_tpu.serving.router import FleetRouter
+
+    sup = WorkerSupervisor(
+        stub_worker_cmd,
+        FleetConfig(num_workers=1, state_dir=str(tmp_path)))
+    router = FleetRouter(sup, port=0)
+    router.set_versions({"weights": {"v1": 3, "v2": 1},
+                         "shadow": {"candidate": "v2", "fraction": 0.25}})
+    rec = check_cli_contract_text(
+        "noise\n" + json.dumps(router.versions_record()), "versions")
+    assert rec["schema"] == "versions/v1"
+    assert rec["weights"] == {"v1": 3.0, "v2": 1.0}
+    assert rec["shadow"]["candidate"] == "v2"
+    assert rec["shadow_samples"] == 0 and rec["promotions"] == 0
 
 
 def test_sustained_kind_matches_real_contract_builder():
@@ -247,6 +276,32 @@ def test_bench_headline_carries_input_pipeline_keys():
     assert line["input_pipeline"]["prefetch_overlap_ratio"] == 1.21
     assert line["input_pipeline"]["scan_prefetch_cps"] == 9.4
     assert "per_step_skipped" not in line["input_pipeline"]
+    rec = check_cli_contract_text(json.dumps(line), "bench")
+    assert rec["value"] == 33.0
+
+
+def test_bench_headline_carries_elasticity_keys():
+    """The bench elasticity section's gated keys ride the contract line
+    (tools/check_perf_regression.py gates elasticity.p99_ratio and the
+    zero-bar elasticity.dropped_requests)."""
+    import bench
+
+    line = bench._build_headline(
+        {"buckets": {"b1_p128": {"train_scan_complexes_per_sec": 33.0,
+                                 "batch": 1,
+                                 "train_scan_ms_per_step": 30.0}},
+         "elasticity": {"steady_p99_ms": 26.0,
+                        "p99_during_scale_ms": 31.2, "p99_ratio": 1.2,
+                        "dropped_requests": 0, "scale_ups": 2,
+                        "scale_downs": 1, "preemptions": 1,
+                        "peak_workers": 3, "final_workers": 1,
+                        "note": "not a contract key"},
+         "interaction_stem": "factorized", "compute_dtype": "float32"},
+        scan_k=8)
+    assert line["elasticity"]["p99_ratio"] == 1.2
+    assert line["elasticity"]["dropped_requests"] == 0
+    assert line["elasticity"]["preemptions"] == 1
+    assert "note" not in line["elasticity"]
     rec = check_cli_contract_text(json.dumps(line), "bench")
     assert rec["value"] == 33.0
 

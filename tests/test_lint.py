@@ -92,10 +92,13 @@ def test_repo_wide_suppressions_are_intentional(capsys):
     main([])
     rec = json.loads(
         [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
-    # 20 = 10 pre-ISSUE-12 pragmas + 9 artifact-write waivers + the
+    # 24 = 10 pre-ISSUE-12 pragmas + 9 artifact-write waivers + the
     # ISSUE-15 loader-boundary waiver on the SWA params placement
-    # (training/loop.py — a params tree, not a batch). artifact-write
-    # waivers: (streaming
+    # (training/loop.py — a params tree, not a batch) + 4 ISSUE-16
+    # lock-discipline waivers in the router's _choose_version_locked
+    # (a caller-holds-_lock helper: the smooth weighted-RR state reads/
+    # writes are guarded by every call site, per the rule's documented
+    # convention). artifact-write waivers: (streaming
     # sinks whose readers tolerate a torn tail — including the fleet
     # supervisor's append-only child-process logs (ISSUE-13) —
     # transient/regenerable outputs incl. the ISSUE-14 synthetic split
@@ -104,7 +107,7 @@ def test_repo_wide_suppressions_are_intentional(capsys):
     # artifacts.atomic_write (train_supervisor_state.json does; the
     # train_supervise/v1 contract prints from cli/train.py, which the
     # no-print rule exempts).
-    assert rec["suppressed"] <= 20, (
+    assert rec["suppressed"] <= 24, (
         "suppression count grew — justify or fix the new ones")
 
 

@@ -428,6 +428,11 @@ def _train_cmd(train_env, ckpt_dir, extra):
 
 def _run(cmd, cwd, timeout=420, env_extra=None, popen=False):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # children run from tmp_path, so the repo checkout must be on their
+    # import path explicitly — inheriting the parent's cwd-based lookup
+    # does not survive the cwd change
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     env.update(env_extra or {})
     if popen:
         return subprocess.Popen(cmd, cwd=str(cwd), env=env,

@@ -70,22 +70,40 @@ CONTRACTS = {
     # --workers N) and every POST /admin/rollover response
     # (serving/router.py FleetRouter.final_contract).
     "fleet": {
+        # preemptions + versions are the ISSUE-16 additions: expected
+        # capacity losses absorbed (no circuit penalty) and the count of
+        # live checkpoint versions behind the router.
         "required": ("schema", "metric", "value", "unit", "ok",
                      "workers", "healthy", "restarts", "circuit_open",
-                     "rollovers", "failovers", "routed"),
+                     "rollovers", "failovers", "routed", "preemptions",
+                     "versions"),
         "numeric": ("value", "workers", "healthy", "restarts",
-                    "circuit_open", "rollovers", "failovers", "routed"),
+                    "circuit_open", "rollovers", "failovers", "routed",
+                    "preemptions", "versions"),
+    },
+    # versions/v1: GET /admin/versions on the fleet router (serving/
+    # router.py versions_record; also cli/serve.py --versions): canary
+    # weights, per-version worker counts, shadow evidence, promotions.
+    "versions": {
+        "required": ("schema", "metric", "value", "unit", "ok",
+                     "weights", "workers_by_version", "shadow",
+                     "shadow_samples", "promotions"),
+        "numeric": ("value", "shadow_samples", "promotions"),
     },
     # fsck/v1: python -m deepinteract_tpu.cli.fsck (durable-artifact
     # verify/quarantine/report; robustness/artifacts.py).
     # stale_heartbeat_hosts + resume_cursor are the ISSUE-14 additions:
     # which hosts went quiet, and where --resume would land.
+    # fleet_versions + stale_version_ledgers are the ISSUE-16 additions:
+    # per-version worker counts from fleet_state.json, and agreement
+    # ledgers no weighted/shadowed version can consume.
     "fsck": {
         "required": ("schema", "metric", "value", "unit", "ok", "root",
                      "scanned", "verified", "unverified", "corrupt",
                      "quarantined", "tmp_files", "corrupt_paths",
                      "stale_heartbeats", "stale_heartbeat_hosts",
-                     "resume_cursor"),
+                     "resume_cursor", "fleet_versions",
+                     "stale_version_ledgers"),
         "numeric": ("value", "scanned", "verified", "unverified",
                     "corrupt", "quarantined", "tmp_files",
                     "stale_heartbeats"),

@@ -92,6 +92,15 @@ TOLERANCES = {
     # mid/ checkpoint stopped landing.
     "recovery.mttr_s": (1.00, -1),
     "recovery.steps_reexecuted": (0.0, -1),
+    # Elastic-fleet contract (bench `elasticity` section, ISSUE-16): the
+    # burst-phase tail over the steady baseline while the autoscaler
+    # scales up, scales down, and absorbs a preemption — gate the RATIO
+    # (like rollover.p99_ratio) so a fleet-layer tail regression can't
+    # hide behind a shifted steady state. Dropped requests across the
+    # whole diurnal trace have a ZERO bar (ZERO_BASELINE_CEILINGS):
+    # elasticity must never shed correct traffic.
+    "elasticity.p99_ratio": (0.50, -1),
+    "elasticity.dropped_requests": (0.0, -1),
     # Input-pipeline contract (bench `input_pipeline` section, ISSUE-15):
     # prefetch_overlap_ratio is the stepped-loader rate with placement
     # double-buffered on the prefetch thread over the inline-placement
@@ -111,6 +120,7 @@ TOLERANCES = {
 # an absolute ceiling — fresh must stay <= baseline + ceiling.
 ZERO_BASELINE_CEILINGS = {
     "rollover.dropped_requests": 0.0,
+    "elasticity.dropped_requests": 0.0,
     # The bench recovery section kills within one save cadence of the
     # last mid-epoch checkpoint, so even a 0-baseline round must keep
     # re-executed work under that cadence (2.0 is the section default;
