@@ -312,7 +312,17 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--screen_max_pairs", type=int, default=512,
                    help="largest synchronous POST /screen (pairs); "
                         "bigger screens are refused 400 toward "
-                        "cli/screen.py (manifest + resume)")
+                        "cli/screen.py (manifest + resume). Indexed "
+                        "screens (--index_path / payload index_path) are "
+                        "exempt: they stream decode micro-batches with "
+                        "partial-result flushes under the deadline")
+    g.add_argument("--index_path", type=str, default=None,
+                   help="proteome-index directory (cli/index.py build) "
+                        "preloaded at startup; POST /screen with "
+                        '{"indexed": true} then serves ranked-partner '
+                        "queries against it without re-sending the path. "
+                        "Propagates to every fleet worker via the shared "
+                        "base argv")
     g.add_argument("--events_out", type=str, default=None,
                    help="span event log (JSONL) for request-scoped "
                         "tracing: every traced request's queue-wait/"
@@ -456,6 +466,30 @@ def add_screening_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--manifest", type=str, default=None,
                    help="progress-ledger path (atomic per-batch flush; an "
                         "existing matching manifest resumes the screen)")
+
+
+def add_index_args(p: argparse.ArgumentParser) -> None:
+    """Proteome-index surface (cli/index.py, cli/query.py;
+    deepinteract_tpu.index)."""
+    g = p.add_argument_group("proteome index")
+    g.add_argument("--index_dir", type=str, default="index_out",
+                   help="index directory: build/merge target, "
+                        "verify/query source (manifest + partitions/)")
+    g.add_argument("--partition_size", type=int, default=64,
+                   help="chains per index partition shard (the build's "
+                        "exactly-once unit of work)")
+    g.add_argument("--merge_from", action="append", default=None,
+                   metavar="DIR",
+                   help="source index for 'merge' (repeat per source; "
+                        "all must share the embedding identity and be "
+                        "chain-disjoint)")
+    g.add_argument("--top_m", type=int, default=32,
+                   help="pre-filter survivors handed to the decoder per "
+                        "query (the funnel neck; index/prefilter.py)")
+    g.add_argument("--allow_stale", action="store_true",
+                   help="query an index whose weights_signature no "
+                        "longer matches the engine (rankings may be "
+                        "garbage; meant for format debugging only)")
 
 
 def add_tuning_args(p: argparse.ArgumentParser) -> None:

@@ -47,6 +47,13 @@ KNOWN_UNVERIFIED_BASENAMES = ("trainer_state.json", "tuning_store.json",
 # does the math — shared with the fleet supervisor's liveness check).
 HEARTBEAT_MAX_AGE_S = 300.0
 
+# Proteome-index artifacts (deepinteract_tpu/index/format.py — the
+# names are duplicated here so fsck stays importable without pulling
+# the engine stack). Shards REQUIRE a sidecar: every writer goes
+# through atomic_write_artifact, so a naked shard is a stray.
+INDEX_MANIFEST_BASENAME = "index_manifest.json"
+INDEX_SHARD_PREFIX = "part-"
+
 
 def _known_json_artifact(name: str) -> bool:
     # Heartbeats are per-process files: obs/heartbeat_p<N>.json
@@ -287,6 +294,57 @@ def _check_fleet_state(path: str, report: Dict) -> None:
                 os.path.join(state_dir, name))
 
 
+def _check_index_manifest(path: str, report: Dict) -> None:
+    """Census the proteome-index partition manifest (cli/index.py
+    ``build``). Byte integrity is covered by the sidecar check above;
+    here the structure is validated (a manifest whose partition table
+    does not parse would wedge every indexed /screen at 400) and the
+    partition count + weights_signature are collected so ``main`` can
+    cross-reference against the served fleet versions: an index frozen
+    at a signature NO healthy worker serves is promotion debt — queries
+    against it either 409 at the server or silently rank with stale
+    weights under --allow_stale."""
+    if any(e["path"] == path for e in report["corrupt_paths"]):
+        return  # integrity layer already flagged (and maybe moved) it
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return  # already flagged by the parse checks above
+    if not isinstance(payload, dict):
+        return
+    sig = payload.get("weights_signature")
+    partitions = payload.get("partitions")
+    problems = []
+    if not isinstance(sig, str) or not sig:
+        problems.append("weights_signature missing")
+    if not isinstance(partitions, list) or not all(
+            isinstance(p, dict) and isinstance(p.get("partition_id"), str)
+            and isinstance(p.get("file"), str)
+            for p in partitions):
+        problems.append("partitions is not a partition-record list")
+        partitions = []
+    else:
+        index_dir = os.path.dirname(path)
+        missing = [p["partition_id"] for p in partitions
+                   if not os.path.exists(os.path.join(index_dir,
+                                                      p["file"]))]
+        if missing:
+            problems.append("manifest references missing shards: "
+                            + ", ".join(missing[:5]))
+    if problems:
+        _mark_corrupt(path, "index manifest malformed: "
+                      + "; ".join(problems), "index-manifest", report)
+        return
+    report["index_partitions"] = (report.get("index_partitions", 0)
+                                  + len(partitions))
+    report.setdefault("index_manifests", []).append({
+        "path": path, "weights_signature": sig,
+        "partitions": len(partitions),
+        "chains": payload.get("num_chains"),
+    })
+
+
 def _mark_corrupt(path: str, reason: str, kind: str, report: Dict) -> None:
     report["corrupt_paths"].append({"path": path, "kind": kind,
                                     "reason": reason})
@@ -330,11 +388,19 @@ def scan(root: str, do_quarantine: bool, do_sweep: bool) -> Dict:
                     report["orphan_sidecars"].append(path)
                 continue
             has_sidecar = os.path.exists(artifacts.sidecar_path(path))
-            # Embedding spills REQUIRE a sidecar (the cache quarantines
-            # strays at read); everything else degrades to unverified.
+            # Embedding spills and index shards REQUIRE a sidecar (their
+            # readers quarantine strays); everything else degrades to
+            # unverified.
             spill = name.startswith("emb_") and name.endswith(".npz")
-            if has_sidecar or spill or _known_json_artifact(name):
-                _check_file(path, report, require_sidecar=spill)
+            shard = (name.startswith(INDEX_SHARD_PREFIX)
+                     and name.endswith(".npz"))
+            idx_manifest = name == INDEX_MANIFEST_BASENAME
+            if (has_sidecar or spill or shard or idx_manifest
+                    or _known_json_artifact(name)):
+                _check_file(path, report,
+                            require_sidecar=spill or shard or idx_manifest)
+            if idx_manifest:
+                _check_index_manifest(path, report)
             if name == "trainer_state.json":
                 _check_trainer_cursor(path, report)
             if name == "fleet_state.json":
@@ -390,6 +456,23 @@ def main(argv=None) -> int:
     for path in report.get("stale_version_ledgers", []):
         print("stale version ledger (version neither weighted nor "
               f"shadowed): {path}")
+    # An index partition is STALE when its frozen weights_signature
+    # matches no version a healthy worker serves (fleet_state.json
+    # census above) — the embeddings can still be read, but indexed
+    # /screen against them either 409s at version check or ranks with
+    # weights the fleet has moved past. Only judged when a fleet census
+    # exists in the scanned tree: a bare index directory has no serving
+    # context to be stale AGAINST.
+    served = set(((report.get("fleet_versions") or {})
+                  .get("workers_by_version") or {}))
+    stale_index = []
+    if served:
+        for m in report.get("index_manifests", []):
+            if m["weights_signature"] not in served:
+                stale_index.append(m["path"])
+                print(f"stale index partitions ({m['partitions']} @ "
+                      f"weights {m['weights_signature']}, served "
+                      f"versions {sorted(served)}): {m['path']}")
     for path in report["tmp_paths"]:
         swept = " (swept)" if (args.sweep_tmp or args.quarantine) else ""
         print(f"orphan tmp: {path}{swept}")
@@ -417,6 +500,8 @@ def main(argv=None) -> int:
         "resume_cursor": report.get("resume_cursor"),
         "fleet_versions": report.get("fleet_versions"),
         "stale_version_ledgers": report.get("stale_version_ledgers", []),
+        "index_partitions": report.get("index_partitions", 0),
+        "stale_index_partitions": stale_index,
         "tmp_files": len(report["tmp_paths"]),
         "tmp_swept": report["tmp_swept"],
         "corrupt_paths": [e["path"] for e in report["corrupt_paths"][:20]],

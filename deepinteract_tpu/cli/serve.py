@@ -340,6 +340,7 @@ def main(argv=None, guard=None) -> int:
         request_timeout_s=args.request_timeout_s,
         screen_max_pairs=args.screen_max_pairs,
         default_deadline_ms=args.default_deadline_ms,
+        index_path=args.index_path,
         shedder_cfg=ShedderConfig(
             enabled=not args.no_load_shedding,
             enter_utilization=args.shed_enter_util,

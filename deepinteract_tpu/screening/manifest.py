@@ -98,6 +98,17 @@ class ScreenManifest:
         self.completed[pid] = record
         self._dirty = True
 
+    def discard(self, pid: str) -> bool:
+        """Un-complete one work unit (True when it was completed). The
+        index builder uses this when a LEDGER-complete partition's shard
+        turns out corrupt on disk: quarantine the shard, discard its
+        ledger entry, and only that partition is rebuilt."""
+        if pid in self.completed:
+            del self.completed[pid]
+            self._dirty = True
+            return True
+        return False
+
     def flush(self) -> None:
         """Atomic write; called after every decode batch and on
         preemption. A reader never sees a torn manifest."""

@@ -108,6 +108,9 @@ _SHADOW = obs_metrics.counter(
     "di_fleet_shadow_total",
     "Shadow-mirrored requests by comparison outcome",
     labelnames=("outcome",))
+_INDEXED_FANOUTS = obs_metrics.counter(
+    "di_fleet_indexed_screens_total",
+    "Indexed /screen queries scatter/gathered across partition groups")
 _PROMOTIONS = obs_metrics.counter(
     "di_fleet_promotions_total", "Version promotion attempts",
     labelnames=("outcome",))
@@ -268,13 +271,26 @@ class FleetRouter:
                 except ValueError as exc:
                     self._send_json(400, {"error": str(exc)})
                     return
-                status, out, headers = router.proxy(
-                    "POST", self.path, body,
-                    content_type=self.headers.get(
-                        "Content-Type", "application/octet-stream"),
-                    bucket_hint=self.headers.get("X-DI-Bucket"),
-                    deadline=deadline,
-                    version=self._version_pin(body))
+                if (route == "/screen" and body
+                        and b'"index_path"' in body
+                        and b'"partitions"' not in body):
+                    # Indexed screen: scatter partition groups across
+                    # the fleet, gather + merge the rankings. A body
+                    # that already scopes "partitions" is a sub-request
+                    # (or a client wanting one worker) and proxies
+                    # normally — no recursive fan-out.
+                    status, out, headers = router.indexed_screen(
+                        body, deadline=deadline,
+                        version=self._version_pin(body))
+                else:
+                    status, out, headers = router.proxy(
+                        "POST", self.path, body,
+                        content_type=self.headers.get(
+                            "Content-Type",
+                            "application/octet-stream"),
+                        bucket_hint=self.headers.get("X-DI-Bucket"),
+                        deadline=deadline,
+                        version=self._version_pin(body))
                 self._send_body(status, out,
                                 headers.pop("Content-Type",
                                             "application/json"),
@@ -526,6 +542,129 @@ class FleetRouter:
         elif status == 200:
             self._maybe_shadow(method, path, body, content_type, out)
         return status, out, headers
+
+    def indexed_screen(self, body: bytes,
+                       deadline: Optional[Deadline] = None,
+                       version: Optional[str] = None,
+                       ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Partition-affine scatter/gather for an indexed ``/screen``.
+
+        The router reads the index manifest (partition table only — it
+        never touches shard bytes), assigns every partition to a worker
+        slot by ``crc32(partition_id) % n_workers`` — the SAME affinity
+        hash ``_pick_sequence`` applies to the sub-request's
+        ``bucket_hint``, so each worker owns a stable partition slice
+        and its shard cache stays warm — and fans the sub-requests (the
+        client body + a ``partitions`` scope) through :meth:`_route`,
+        inheriting failover and version-pinning unchanged: a worker
+        SIGKILL'd mid-query just moves its groups to siblings. Gather
+        merges the per-group rankings by ``(-score, pair_id)``; groups
+        that failed every retry mark the merged answer ``partial``
+        rather than voiding the survivors that did come back."""
+        try:
+            payload = json.loads(body.decode())
+            if not isinstance(payload, dict):
+                raise ValueError("screen body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as exc:
+            return self._count(400, json.dumps(
+                {"error": f"indexed screen body: {exc}"}).encode(), {})
+        from deepinteract_tpu.index.format import read_manifest
+        try:
+            manifest = read_manifest(str(payload.get("index_path")))
+        except (artifacts.ArtifactError, OSError, TypeError) as exc:
+            return self._count(400, json.dumps(
+                {"error": f"index: {exc}"}).encode(), {})
+        pids = sorted(p["partition_id"] for p in manifest["partitions"])
+        if not pids:
+            return self._count(400, json.dumps(
+                {"error": "index has no partitions"}).encode(), {})
+        sequence = self._pick_sequence(None, version)
+        if not sequence:
+            return self._count(503, json.dumps({
+                "error": "no healthy worker available for indexed "
+                         "screen" + (f" (version {version!r})"
+                                     if version else ""),
+                "retry_after_s": 1.0,
+            }).encode(), {"Retry-After": "1"})
+        n = len(sequence)
+        groups: Dict[int, List[str]] = {}
+        for pid in pids:
+            groups.setdefault(zlib.crc32(pid.encode()) % n,
+                              []).append(pid)
+        join_s = (deadline.remaining_s() + 1.0 if deadline is not None
+                  else self.cfg.proxy_timeout_s + 1.0)
+        tasks = {}
+        for g in sorted(groups):
+            sub = json.dumps({**payload,
+                              "partitions": groups[g]}).encode()
+            tasks[g] = (lambda b=sub, hint=groups[g][0]: self._route(
+                "POST", "/screen", b, "application/json", hint,
+                deadline, version))
+        _INDEXED_FANOUTS.inc()
+        results = fan_out(tasks, join_timeout_s=join_s,
+                          name="indexed-screen")
+        merged: List[Dict] = []
+        served: List[str] = []
+        failed: List[Dict] = []
+        statuses: List[int] = []
+        partial = False
+        totals = {"candidates": 0, "survivors": 0, "pairs_decoded": 0}
+        for g in sorted(groups):
+            res = results.get(g)
+            if res is None:
+                failed.append({"partitions": groups[g],
+                               "error": "fan-out timed out"})
+                continue
+            status, out, _ = res
+            if status != 200:
+                try:
+                    err = json.loads(out.decode()).get("error", "")
+                except (ValueError, UnicodeDecodeError):
+                    err = out[:200].decode(errors="replace")
+                failed.append({"partitions": groups[g],
+                               "status": status, "error": err})
+                statuses.append(status)
+                continue
+            try:
+                sub_out = json.loads(out.decode())
+            except (ValueError, UnicodeDecodeError):
+                failed.append({"partitions": groups[g],
+                               "error": "torn worker response"})
+                continue
+            merged.extend(sub_out.get("ranked", []))
+            served.extend(sub_out.get("partitions_served", groups[g]))
+            partial = partial or bool(sub_out.get("partial"))
+            for key in totals:
+                totals[key] += int(sub_out.get(key, 0))
+        if failed and not merged and len(failed) == len(groups):
+            status = (statuses[0] if statuses
+                      and all(s == statuses[0] for s in statuses)
+                      else 503)
+            return self._count(status, json.dumps({
+                "error": "indexed screen failed on every partition "
+                         "group",
+                "failed_groups": len(failed),
+                "failed_detail": failed}).encode(), {})
+        merged.sort(key=lambda r: (-float(r.get("score", 0.0)),
+                                   str(r.get("pair_id", ""))))
+        answer = {
+            "indexed": True,
+            "index_path": payload.get("index_path"),
+            "query": payload.get("query"),
+            "chains": int(manifest["num_chains"]),
+            "partitions": len(pids),
+            "partitions_served": sorted(served),
+            "fanout_groups": len(groups),
+            "failed_groups": len(failed),
+            "failed_detail": failed,
+            "partial": partial or bool(failed),
+            "ranked": merged,
+            **totals,
+        }
+        headers = {"X-DI-Fanout": str(len(groups))}
+        if version is not None:
+            headers["X-DI-Version"] = version
+        return self._count(200, json.dumps(answer).encode(), headers)
 
     def _route(self, method: str, path: str, body: bytes,
                content_type: str, bucket_hint: Optional[str],

@@ -64,6 +64,7 @@ from __future__ import annotations
 import io
 import json
 import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -179,7 +180,8 @@ class ServingServer:
                  port: int = 8008, request_timeout_s: float = 120.0,
                  screen_max_pairs: int = 512,
                  default_deadline_ms: float = 0.0,
-                 shedder_cfg: Optional[ShedderConfig] = None):
+                 shedder_cfg: Optional[ShedderConfig] = None,
+                 index_path: Optional[str] = None):
         self.engine = engine
         self.latency = _LatencyTracker()
         self._draining = threading.Event()
@@ -201,6 +203,15 @@ class ServingServer:
         # interleaved screens would just thrash the device queue.
         self._screen_cache = None
         self._screen_lock = threading.Lock()
+        # Proteome indexes (deepinteract_tpu.index): opened handles are
+        # cached per path (shards verify once, stay resident). A
+        # --index_path preload happens HERE so a worker with a bad or
+        # stale index fails at startup, not on its first query.
+        self.index_path = index_path
+        self._indices: Dict[str, Any] = {}
+        self._index_lock = threading.Lock()
+        if index_path:
+            self._get_index(index_path)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -520,6 +531,9 @@ class ServingServer:
             enumerate_pairs,
         )
 
+        if payload.get("index_path") or (
+                self.index_path and payload.get("indexed")):
+            return self._run_indexed_screen(payload, deadline=deadline)
         npz_paths = payload.get("npz_paths")
         if not npz_paths or not isinstance(npz_paths, list):
             raise ValueError("screen body needs 'npz_paths': a non-empty "
@@ -550,6 +564,90 @@ class ServingServer:
         return {
             "chains": result.chains,
             "pairs": result.pairs_total,
+            "ranked": result.records,
+            **result.summary(),
+        }
+
+    def _get_index(self, path: str):
+        """Open-or-cached ChainIndex handle; manifest problems surface
+        as ValueError (-> 400), never as a silent empty index."""
+        from deepinteract_tpu.index import ChainIndex
+        from deepinteract_tpu.robustness import artifacts
+
+        key = os.path.abspath(str(path))
+        with self._index_lock:
+            hit = self._indices.get(key)
+            if hit is not None:
+                return hit
+        try:
+            index = ChainIndex.open(key)
+        except artifacts.ArtifactError as exc:
+            raise ValueError(f"index at {path}: {exc}")
+        with self._index_lock:
+            return self._indices.setdefault(key, index)
+
+    def _run_indexed_screen(self, payload: Dict,
+                            deadline: Optional[Deadline] = None) -> Dict:
+        """Ranked-partner query against a prebuilt proteome index.
+
+        EXEMPT from ``screen_max_pairs``: the pre-filter bounds decoder
+        work to top-M survivors regardless of library size, and the
+        decode loop streams micro-batches under the request deadline —
+        expiry mid-decode FLUSHES the partners ranked so far with
+        ``partial: true`` instead of burning the whole budget into a
+        504 (an indexed library is exactly the case where a prefix of
+        the ranking is still useful)."""
+        from deepinteract_tpu.index import IndexedQueryRunner, QueryConfig
+        from deepinteract_tpu.screening import ChainLibrary, EmbeddingCache
+
+        index = self._get_index(payload.get("index_path")
+                                or self.index_path)
+        query = payload.get("query")
+        if isinstance(query, list):
+            if len(query) != 1:
+                raise ValueError("indexed screen needs exactly one "
+                                 "'query' chain id")
+            query = query[0]
+        if not query:
+            raise ValueError("indexed screen needs 'query': the chain id "
+                             "to rank partners for")
+        query = str(query)
+        partitions = payload.get("partitions")
+        if partitions is not None and not isinstance(partitions, list):
+            raise ValueError("'partitions' must be a list of partition "
+                             "ids")
+        with self._screen_lock:
+            if self._screen_cache is None:
+                self._screen_cache = EmbeddingCache()
+            runner = IndexedQueryRunner(
+                self.engine, index,
+                cfg=QueryConfig(
+                    top_m=int(payload.get("top_m", 32)),
+                    top_k=int(payload.get("top_k", 10)),
+                    decode_batch=self.engine.cfg.max_batch),
+                cache=self._screen_cache,
+                allow_stale=bool(payload.get("allow_stale", False)))
+            npz_paths = payload.get("npz_paths")
+            if npz_paths:
+                library = ChainLibrary.from_complex_files(
+                    [str(p) for p in npz_paths])
+                entry = library[query]
+                result = runner.query_from_raw(
+                    entry.chain_id, entry.raw, partitions=partitions,
+                    deadline=deadline, on_deadline="partial")
+            else:
+                result = runner.query_from_index(
+                    query, partitions=partitions, deadline=deadline,
+                    on_deadline="partial")
+        return {
+            "indexed": True,
+            "index_path": index.index_dir,
+            "query": result.query,
+            "chains": index.num_chains,
+            "partitions_served": (sorted(partitions)
+                                  if partitions is not None
+                                  else index.partition_ids()),
+            "weights_signature": self.engine.weights_signature(),
             "ranked": result.records,
             **result.summary(),
         }

@@ -115,7 +115,7 @@ class StubWorker:
             def do_POST(self):  # noqa: N802 - stdlib name
                 route = self.path.partition("?")[0]
                 length = int(self.headers.get("Content-Length", 0))
-                self.rfile.read(length)
+                body = self.rfile.read(length)
                 if route not in ("/predict", "/screen"):
                     self._send_json(404, {"error": f"no route {route}"})
                     return
@@ -144,6 +144,10 @@ class StubWorker:
                     # otherwise a drain racing the send tears the
                     # connection and the clean-drain contract breaks.
                     time.sleep(worker.delay_s)
+                    if route == "/screen" and b'"index_path"' in body:
+                        code, out = worker.indexed_screen(body)
+                        self._send_json(code, out)
+                        return
                     self._send_json(200, {
                         "complex_name": "stub",
                         "n1": 1, "n2": 1, "bucket": [64, 64],
@@ -167,6 +171,62 @@ class StubWorker:
     @property
     def warm(self) -> bool:
         return time.monotonic() >= self._warm_at
+
+    def indexed_screen(self, body: bytes):
+        """Deterministic fake of the real server's indexed ``/screen``
+        (ranked partners from a proteome index): reads ONLY the index
+        manifest's partition table — no numpy, no shard bytes — and
+        scores each chain as ``crc32(chain_id) % 10^4 / 10^4``. Two
+        stubs given the same partitions answer identically, so the
+        router's scatter/gather merge and SIGKILL failover are testable
+        against real fleet processes in the fast tier."""
+        import zlib
+
+        try:
+            payload = json.loads(body.decode())
+            manifest_file = os.path.join(
+                str(payload["index_path"]), "index_manifest.json")
+            with open(manifest_file) as fh:
+                manifest = json.load(fh)
+        except (KeyError, ValueError, OSError) as exc:
+            return 400, {"error": f"stub indexed screen: {exc}"}
+        wanted = payload.get("partitions")
+        query = str(payload.get("query", "stub-query"))
+        ranked = []
+        served = []
+        for part in manifest.get("partitions", []):
+            pid = part.get("partition_id")
+            if wanted is not None and pid not in wanted:
+                continue
+            served.append(pid)
+            for cid in part.get("chains", []):
+                if cid == query:
+                    continue
+                score = (zlib.crc32(str(cid).encode()) % 10_000) / 10_000
+                ranked.append({
+                    "pair_id": f"{query}|{cid}",
+                    "chain1": query, "chain2": cid,
+                    "query": query, "partner": cid,
+                    "score": score, "max_prob": score,
+                    "prefilter_score": score,
+                    "partition_id": pid, "top_k": 0,
+                    "top_contacts": [],
+                })
+        ranked.sort(key=lambda r: (-r["score"], r["pair_id"]))
+        top_m = int(payload.get("top_m", 0))
+        survivors = ranked[:top_m] if top_m > 0 else ranked
+        return 200, {
+            "indexed": True,
+            "query": query,
+            "partitions_served": sorted(served),
+            "candidates": len(ranked),
+            "survivors": len(survivors),
+            "pairs_decoded": len(survivors),
+            "partial": False,
+            "ranked": survivors,
+            "worker_id": self.worker_id,
+            "weights_signature": self.weights_signature,
+        }
 
     def healthz(self) -> Dict:
         warm = self.warm

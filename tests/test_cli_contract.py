@@ -73,7 +73,8 @@ def test_registered_kinds_cover_every_contract_cli():
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
             "perf_regression", "lint", "fsck", "fleet", "versions",
-            "train_supervise", "sustained"} <= set(CONTRACTS)
+            "train_supervise", "sustained", "index", "query"} <= set(
+                CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
 
@@ -257,6 +258,54 @@ def test_sustained_kind_matches_real_contract_builder():
     assert rec["device_prefetch"] is True
 
 
+TINY_MODEL_ARGS = [
+    "--num_gnn_layers", "1", "--num_gnn_hidden_channels", "16",
+    "--num_gnn_attention_heads", "2", "--num_interact_layers", "1",
+    "--num_interact_hidden_channels", "8", "--dropout_rate", "0.0",
+]
+
+
+def test_index_and_query_kinds_match_real_cli_emission(tmp_path, capsys):
+    """The index/v1 and query/v1 contracts are validated against the
+    REAL CLI lifecycle on a tiny synthetic library: build -> verify ->
+    ranked-partner query, each capture's final line through its
+    registered kind."""
+    from deepinteract_tpu.cli.index import main as index_main
+    from deepinteract_tpu.cli.query import main as query_main
+
+    idx = str(tmp_path / "idx")
+    rc = index_main(["build", *TINY_MODEL_ARGS,
+                     "--synthetic_chains", "6", "--synthetic_len", "20,40",
+                     "--screen_batch", "4", "--index_dir", idx,
+                     "--partition_size", "4"])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "index")
+    assert rec["schema"] == "index/v1" and rec["ok"]
+    assert rec["action"] == "build" and rec["chains"] == 6
+    assert rec["encodes_executed"] == 6 and not rec["resumed"]
+
+    rc = index_main(["verify", "--index_dir", idx])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "index")
+    assert rec["action"] == "verify" and rec["ok"]
+    assert rec["corrupt"] == 0 and rec["chains"] == 6
+
+    rc = query_main([*TINY_MODEL_ARGS, "--index_dir", idx,
+                     "--query", "syn0001", "--screen_batch", "4",
+                     "--top_m", "3", "--out", str(tmp_path / "q1")])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "query")
+    assert rec["schema"] == "query/v1" and rec["ok"]
+    assert rec["query"] == "syn0001"
+    assert rec["survivors"] == rec["pairs_decoded"] == 3
+    assert rec["candidates"] == 5 and not rec["partial"]
+    assert rec["top_partner"] is not None
+    with open(rec["ranked_out"]) as fh:
+        rows = [json.loads(ln) for ln in fh]
+    assert [r["rank"] for r in rows] == [1, 2, 3]
+    assert rows[0]["partner"] == rec["top_partner"]["partner"]
+
+
 def test_bench_headline_carries_input_pipeline_keys():
     """The bench input_pipeline section's gated keys ride the contract
     line (tools/check_perf_regression.py gates
@@ -302,6 +351,35 @@ def test_bench_headline_carries_elasticity_keys():
     assert line["elasticity"]["dropped_requests"] == 0
     assert line["elasticity"]["preemptions"] == 1
     assert "note" not in line["elasticity"]
+    rec = check_cli_contract_text(json.dumps(line), "bench")
+    assert rec["value"] == 33.0
+
+
+def test_bench_headline_carries_indexed_screening_keys():
+    """The bench screening.indexed subsection's gated keys ride the
+    contract line (tools/check_perf_regression.py gates
+    screening.indexed.indexed_pairs_per_sec / query_p50_ms)."""
+    import bench
+
+    line = bench._build_headline(
+        {"buckets": {"b1_p128": {"train_scan_complexes_per_sec": 33.0,
+                                 "batch": 1,
+                                 "train_scan_ms_per_step": 30.0}},
+         "screening": {"screen_pairs_per_sec": 40.0, "chains": 12,
+                       "pairs": 66,
+                       "indexed": {"indexed_pairs_per_sec": 900.0,
+                                   "query_p50_ms": 45.0,
+                                   "prefilter_survivor_frac": 0.032,
+                                   "chains": 1000, "top_m": 32,
+                                   "build_s": 60.0,
+                                   "note": "not a contract key"}},
+         "interaction_stem": "factorized", "compute_dtype": "float32"},
+        scan_k=8)
+    idx = line["screening"]["indexed"]
+    assert idx["indexed_pairs_per_sec"] == 900.0
+    assert idx["query_p50_ms"] == 45.0
+    assert idx["prefilter_survivor_frac"] == 0.032
+    assert "build_s" not in idx and "note" not in idx
     rec = check_cli_contract_text(json.dumps(line), "bench")
     assert rec["value"] == 33.0
 

@@ -1,0 +1,706 @@
+"""Proteome-index tests (ISSUE-17): format round trip, exactly-once
+build resume, corrupt-shard quarantine, the pre-filter funnel's ranking
+agreement with a full decode, indexed HTTP /screen, and router fan-out.
+
+The engine-backed tests share one module-scoped engine + one built index
+(the compiles and encodes are paid once); the fleet fan-out tests run
+against stub workers (serving/worker_stub.py — no jax) so a REAL
+multi-process scatter/gather with a SIGKILL mid-query fits the fast
+tier. The kill -9 build-resume test drives the real CLI in a subprocess
+and is slow-marked; the same exactly-once ledger contract is pinned
+fast-tier in-process via the ``after_partition`` crash hook.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.index import (
+    ChainIndex,
+    IndexedQueryRunner,
+    QueryConfig,
+    bilinear_scores,
+    build_index,
+    merge_indexes,
+    plan_partitions,
+    pooled_embedding,
+    prefilter,
+    verify_index,
+)
+from deepinteract_tpu.index import format as idx_format
+from deepinteract_tpu.robustness import artifacts
+from deepinteract_tpu.robustness.preemption import PreemptionGuard
+from deepinteract_tpu.screening import (
+    ChainLibrary,
+    EmbeddingCache,
+    ScreenConfig,
+    ScreenRunner,
+    enumerate_pairs,
+)
+from deepinteract_tpu.screening.library import ChainEntry
+from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+from tests.test_screening import TINY_CLI_ARGS, tiny_model_cfg
+
+KNN, GEO = 6, 2
+PART = 4  # partition_size used everywhere here: multiple shards/bucket
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InferenceEngine(
+        tiny_model_cfg(),
+        cfg=EngineConfig(max_batch=8, result_cache_size=0))
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def library():
+    return ChainLibrary.synthetic(10, 20, 40, seed=3, knn=KNN,
+                                  geo_nbrhd_size=GEO)
+
+
+@pytest.fixture(scope="module")
+def built_index(engine, library, tmp_path_factory):
+    """One shared build (module scope): the round-trip assertions live
+    in test_build_verify_round_trip; everything downstream reuses the
+    same shards read-only (tests that corrupt shards copy the tree)."""
+    index_dir = str(tmp_path_factory.mktemp("idx") / "index")
+    result = build_index(engine, library, index_dir, partition_size=PART,
+                         encode_batch=4, cache=EmbeddingCache())
+    return index_dir, result
+
+
+# ---------------------------------------------------------------------------
+# Format + build round trip
+# ---------------------------------------------------------------------------
+
+
+def test_plan_partitions_deterministic_and_bucket_homogeneous(
+        engine, library):
+    plan = plan_partitions(engine, library, PART)
+    assert plan == plan_partitions(engine, library, PART)
+    assert sum(len(cids) for _, _, cids in plan) == len(library)
+    assert len({pid for pid, _, _ in plan}) == len(plan)
+    for pid, bucket, cids in plan:
+        assert 1 <= len(cids) <= PART
+        assert all(engine.chain_bucket(library[c].n) == bucket
+                   for c in cids)
+        assert pid == idx_format.partition_id(
+            bucket, int(pid.rsplit("-", 1)[1]))
+    with pytest.raises(ValueError, match="partition_size"):
+        plan_partitions(engine, library, 0)
+
+
+def test_build_verify_round_trip(engine, library, built_index):
+    index_dir, result = built_index
+    plan = plan_partitions(engine, library, PART)
+    assert result.partitions_total == len(plan)
+    assert result.partitions_built == len(plan)
+    assert result.partitions_resumed == 0 and not result.resumed
+    assert result.chains == len(library)
+    # Build = one encoder pass per chain, never more (cold cache).
+    assert result.encodes_executed == len(library)
+    assert result.weights_signature == engine.weights_signature()
+
+    report = verify_index(index_dir)
+    assert report["ok"] and report["corrupt"] == 0
+    assert report["verified"] == len(plan)
+    assert report["chains"] == len(library)
+
+    index = ChainIndex.open(index_dir)
+    assert index.num_chains == len(library)
+    assert index.chain_ids() == sorted(library.ids())
+    assert index.partition_ids() == sorted(pid for pid, _, _ in plan)
+    assert index.feat_dim > 0
+    # Indexed embeddings ARE the runner's embeddings, byte-for-byte:
+    # what the decode phase consumes is exactly what a live screen uses.
+    runner = ScreenRunner(engine, cache=EmbeddingCache(),
+                          cfg=ScreenConfig(encode_batch=4))
+    cid = library.ids()[0]
+    emb, _, _, _ = runner.ensure_embeddings(library, [cid])
+    feats, n, bucket = index.chain_feats(cid)
+    np.testing.assert_array_equal(feats, emb[cid][0])
+    assert (n, bucket) == (emb[cid][1], emb[cid][2])
+    np.testing.assert_allclose(
+        pooled_embedding(feats, n),
+        index.load_partition(index._chain_loc[cid][0])["pooled"][
+            index._chain_loc[cid][1]], rtol=1e-6)
+
+
+def test_prefilter_scores_and_selection(built_index):
+    index_dir, _ = built_index
+    index = ChainIndex.open(index_dir)
+    cid = index.chain_ids()[0]
+    q_feats, nq, _ = index.chain_feats(cid)
+    q_vec = pooled_embedding(q_feats, nq)
+    survivors, candidates = prefilter(index, q_vec, top_m=4,
+                                      exclude=(cid,))
+    assert candidates == index.num_chains - 1
+    assert len(survivors) == 4
+    assert cid not in {s["chain_id"] for s in survivors}
+    scores = [s["score"] for s in survivors]
+    assert scores == sorted(scores, reverse=True)
+    # Survivors are exactly the arg-top-M of the full bilinear scan.
+    full = {}
+    for pid, cids, lengths, pooled in index.iter_pooled():
+        for c, s in zip(cids, bilinear_scores(q_vec, pooled)):
+            if c != cid:
+                full[c] = float(s)
+    want = sorted(full, key=lambda c: (-full[c], c))[:4]
+    assert [s["chain_id"] for s in survivors] == want
+    for s in survivors:
+        assert s["score"] == pytest.approx(full[s["chain_id"]])
+    # top_m<=0 is uncapped: the router's partition-scoped fan-out uses
+    # it to pull a partition's full ranking from each worker.
+    everyone, cands = prefilter(index, q_vec, top_m=0, exclude=(cid,))
+    assert len(everyone) == cands == len(full)
+
+
+def test_query_full_funnel_matches_screen_ranking(engine, library,
+                                                  built_index):
+    """With top_m >= candidates the funnel decodes everything — its
+    ranking must agree pair-for-pair with a ScreenRunner screen of the
+    same query-vs-library pairs (same decode executables, same
+    transpose-invariant summary)."""
+    index_dir, _ = built_index
+    index = ChainIndex.open(index_dir)
+    cid = library.ids()[3]
+    runner = IndexedQueryRunner(
+        engine, index,
+        cfg=QueryConfig(top_m=len(library), top_k=5, decode_batch=4),
+        cache=EmbeddingCache())
+    result = runner.query_from_index(cid)
+    assert result.candidates == len(library) - 1
+    assert result.survivors == result.pairs_decoded == len(library) - 1
+    assert result.encodes_executed == 0 and not result.partial
+
+    screen = ScreenRunner(
+        engine, cache=EmbeddingCache(),
+        cfg=ScreenConfig(top_k=5, decode_batch=4, encode_batch=4))
+    pairs = [p for p in enumerate_pairs(library) if cid in p]
+    full = screen.screen(library, pairs)
+    assert [r["pair_id"] for r in result.records] == [
+        r["pair_id"] for r in full.records]
+    for got, want in zip(result.records, full.records):
+        assert got["score"] == pytest.approx(want["score"], rel=1e-5)
+        assert got["partner"] in (want["chain1"], want["chain2"])
+
+
+def test_query_decodes_only_prefilter_survivors(engine, built_index):
+    """The funnel-neck proof: the decoder runs on the top-M survivors
+    and NOTHING else — counter-asserted on di_index_pairs_decoded_total
+    and on the number of decode dispatches through the engine."""
+    from deepinteract_tpu.index.funnel import _DECODE_BATCHES, _DECODED
+
+    index_dir, _ = built_index
+    index = ChainIndex.open(index_dir)
+    cid = index.chain_ids()[1]
+    runner = IndexedQueryRunner(
+        engine, index, cfg=QueryConfig(top_m=3, top_k=5, decode_batch=4))
+    dispatches = []
+    real_decode = engine.decode_executable
+
+    def counting_decode(b1, b2, slots, key):
+        dispatches.append((b1, b2, slots))
+        return real_decode(b1, b2, slots, key)
+
+    d0, b0 = _DECODED.value(), _DECODE_BATCHES.value()
+    engine.decode_executable = counting_decode
+    try:
+        result = runner.query_from_index(cid)
+    finally:
+        engine.decode_executable = real_decode
+    assert result.survivors == result.pairs_decoded == 3
+    assert result.candidates == index.num_chains - 1
+    assert 0 < result.prefilter_survivor_frac < 1
+    assert _DECODED.value() - d0 == 3
+    assert _DECODE_BATCHES.value() - b0 == len(dispatches)
+    assert len(dispatches) == result.decode_batches
+    # Every dispatch is survivor-sized: decode capacity across all
+    # dispatches stays under one padded batch per survivor group.
+    assert sum(s for _, _, s in dispatches) <= 2 * result.survivors
+    # Decode ranking is the contract; prefilter order only selects.
+    assert {r["partner"] for r in result.records} == {
+        s["chain_id"] for s in result.prefilter_ranked}
+
+
+def test_stale_index_refused_unless_allow_stale(engine, built_index):
+    index_dir, _ = built_index
+    index = ChainIndex.open(index_dir)
+    index.manifest = dict(index.manifest, weights_signature="other-w")
+    with pytest.raises(ValueError, match="stale index"):
+        IndexedQueryRunner(engine, index)
+    IndexedQueryRunner(engine, index, allow_stale=True)  # explicit opt-in
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once resume + corruption recovery
+# ---------------------------------------------------------------------------
+
+
+def test_build_crash_resumes_exactly_once(engine, library, tmp_path):
+    """A crash after the first partition's shard+ledger landed re-runs
+    the build: the finished partition is NOT re-encoded (exactly-once
+    across runs), the rest completes, the manifest appears only at the
+    end."""
+    index_dir = str(tmp_path / "index")
+    plan = plan_partitions(engine, library, PART)
+
+    class Crash(RuntimeError):
+        pass
+
+    def crash_after_first(done):
+        if done == 1:
+            raise Crash
+
+    with pytest.raises(Crash):
+        build_index(engine, library, index_dir, partition_size=PART,
+                    encode_batch=4, after_partition=crash_after_first)
+    assert not os.path.exists(idx_format.manifest_path(index_dir))
+
+    resumed = build_index(engine, library, index_dir,
+                          partition_size=PART, encode_batch=4)
+    assert resumed.resumed and resumed.partitions_resumed == 1
+    assert resumed.partitions_built == len(plan) - 1
+    assert resumed.partitions_rebuilt == 0
+    first_chains = len(plan[0][2])
+    assert resumed.encodes_executed == len(library) - first_chains
+    assert verify_index(index_dir)["ok"]
+
+
+def test_build_preemption_stops_at_partition_boundary(engine, library,
+                                                      tmp_path):
+    index_dir = str(tmp_path / "index")
+    guard = PreemptionGuard(log=lambda m: None)
+    guard.request("test preemption")
+    result = build_index(engine, library, index_dir, partition_size=PART,
+                         guard=guard)
+    assert result.preempted and result.partitions_built == 0
+    assert result.encodes_executed == 0
+    assert not os.path.exists(idx_format.manifest_path(index_dir))
+    done = build_index(engine, library, index_dir, partition_size=PART)
+    assert not done.preempted
+    assert done.partitions_built == done.partitions_total
+    assert verify_index(index_dir)["ok"]
+
+
+def test_corrupt_shard_quarantined_and_only_it_rebuilds(
+        engine, library, built_index, tmp_path):
+    index_dir = str(tmp_path / "index")
+    shutil.copytree(built_index[0], index_dir)
+    index = ChainIndex.open(index_dir)
+    victim_pid = index.partition_ids()[0]
+    victim = idx_format.shard_path(index_dir, victim_pid)
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(victim, "wb") as fh:  # di: allow[artifact-write] fault injection
+        fh.write(blob)
+
+    untouched = {pid: os.path.getmtime(idx_format.shard_path(index_dir,
+                                                             pid))
+                 for pid in index.partition_ids() if pid != victim_pid}
+    report = verify_index(index_dir)
+    assert not report["ok"] and report["corrupt"] == 1
+    assert report["corrupt_paths"] == [victim]
+
+    result = build_index(engine, library, index_dir, partition_size=PART,
+                         encode_batch=4)
+    assert result.partitions_rebuilt == 1
+    assert result.partitions_built == 1  # ONLY the lost partition
+    victim_chains = len(index.partition(victim_pid)["chains"])
+    assert result.encodes_executed == victim_chains
+    # The damaged bytes were moved aside, not overwritten in place.
+    part_dir = os.path.dirname(victim)
+    assert any(".corrupt-" in name for name in os.listdir(part_dir))
+    for pid, mtime in untouched.items():
+        assert os.path.getmtime(
+            idx_format.shard_path(index_dir, pid)) == mtime
+    assert verify_index(index_dir)["ok"]
+
+
+def test_verify_quarantine_flag_moves_damage_aside(built_index,
+                                                   tmp_path):
+    index_dir = str(tmp_path / "index")
+    shutil.copytree(built_index[0], index_dir)
+    index = ChainIndex.open(index_dir)
+    victim = idx_format.shard_path(index_dir, index.partition_ids()[-1])
+    with open(victim, "ab") as fh:  # di: allow[artifact-write] fault injection
+        fh.write(b"tail garbage")
+    report = verify_index(index_dir, quarantine=True)
+    assert report["corrupt"] == 1 and not report["ok"]
+    assert not os.path.exists(victim)
+    # Reading through the handle now surfaces the loss as typed damage.
+    fresh = ChainIndex.open(index_dir)
+    with pytest.raises(artifacts.ArtifactError):
+        fresh.load_partition(index.partition_ids()[-1])
+
+
+def test_merge_disjoint_indexes_round_trip(engine, tmp_path):
+    lib_a = ChainLibrary.synthetic(4, 20, 40, seed=5, knn=KNN,
+                                   geo_nbrhd_size=GEO)
+    lib_b_raw = ChainLibrary.synthetic(4, 20, 40, seed=6, knn=KNN,
+                                       geo_nbrhd_size=GEO)
+    lib_b = ChainLibrary([ChainEntry(f"b_{e.chain_id}", e.raw, e.n)
+                          for e in lib_b_raw.chains])
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    build_index(engine, lib_a, dir_a, partition_size=PART)
+    build_index(engine, lib_b, dir_b, partition_size=PART)
+
+    out = str(tmp_path / "merged")
+    report = merge_indexes([dir_a, dir_b], out)
+    assert report["ok"] and report["chains"] == 8
+    assert verify_index(out)["ok"]
+    merged = ChainIndex.open(out)
+    assert merged.num_chains == 8
+    assert set(merged.chain_ids()) == set(lib_a.ids()) | set(lib_b.ids())
+    # Embeddings survive the splice byte-for-byte.
+    src = ChainIndex.open(dir_b)
+    cid = lib_b.ids()[0]
+    np.testing.assert_array_equal(merged.chain_feats(cid)[0],
+                                  src.chain_feats(cid)[0])
+    # A merged index serves queries like a built one.
+    result = IndexedQueryRunner(
+        engine, merged, cfg=QueryConfig(top_m=3, decode_batch=4)
+    ).query_from_index(cid)
+    assert result.pairs_decoded == 3 and result.candidates == 7
+
+    with pytest.raises(ValueError, match="at least two"):
+        merge_indexes([dir_a], str(tmp_path / "nope"))
+    with pytest.raises(ValueError, match="appears in both"):
+        merge_indexes([dir_a, dir_a], str(tmp_path / "dup"))
+
+
+# ---------------------------------------------------------------------------
+# fsck over an index tree
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_counts_index_partitions_and_quarantines(built_index,
+                                                      tmp_path, capsys):
+    from deepinteract_tpu.cli.fsck import main as fsck_main
+
+    root = str(tmp_path / "run")
+    index_dir = os.path.join(root, "index")
+    shutil.copytree(built_index[0], index_dir)
+    rc = fsck_main([root])
+    clean = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and clean["ok"]
+    index = ChainIndex.open(index_dir)
+    assert clean["index_partitions"] == len(index.partition_ids())
+    assert clean["stale_index_partitions"] == []  # no fleet census here
+
+    victim = idx_format.shard_path(index_dir, index.partition_ids()[0])
+    blob = bytearray(open(victim, "rb").read())
+    blob[8] ^= 0x01
+    with open(victim, "wb") as fh:  # di: allow[artifact-write] fault injection
+        fh.write(blob)
+    rc = fsck_main([root, "--quarantine"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and rec["recovered"]  # quarantined = recovery done
+    assert rec["corrupt"] == 1 and rec["quarantined"] == 1
+    assert rec["corrupt_paths"] == [victim]
+    assert not os.path.exists(victim)
+
+
+def test_fsck_reports_stale_index_partitions_against_fleet(
+        built_index, tmp_path, capsys):
+    """An index whose weights_signature matches NO healthy served
+    version is promotion debt — fsck cross-references the manifest
+    against the fleet_state.json census in the same tree."""
+    from deepinteract_tpu.cli.fsck import main as fsck_main
+
+    root = str(tmp_path / "run")
+    index_dir = os.path.join(root, "index")
+    shutil.copytree(built_index[0], index_dir)
+    manifest = idx_format.read_manifest(index_dir)
+
+    def fleet_state(sig):
+        artifacts.atomic_write(
+            os.path.join(root, "fleet_state.json"),
+            json.dumps({"workers": {"w0": {
+                "state": "healthy",
+                "health": {"weights_signature": sig}}}}))
+
+    fleet_state(manifest["weights_signature"])
+    rc = fsck_main([root])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and rec["stale_index_partitions"] == []
+
+    fleet_state("rolled-forward-v2")
+    rc = fsck_main([root])
+    out = capsys.readouterr().out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rc == 0  # stale is advisory, not corruption
+    assert rec["stale_index_partitions"] == [
+        idx_format.manifest_path(index_dir)]
+    assert "stale index partitions" in out
+    assert rec["index_partitions"] == len(manifest["partitions"])
+
+
+# ---------------------------------------------------------------------------
+# HTTP: indexed /screen on the real server
+# ---------------------------------------------------------------------------
+
+
+def test_http_indexed_screen_lifts_pair_limit(engine, built_index):
+    import http.client
+
+    from deepinteract_tpu.serving import ServingServer
+
+    index_dir, _ = built_index
+    # screen_max_pairs=3 would refuse ANY classic screen of this
+    # library (9 candidate pairs) — the indexed path must not care.
+    srv = ServingServer(engine, port=0, screen_max_pairs=3,
+                        index_path=index_dir)
+    srv.serve_background()
+    try:
+        host, port = srv.address
+
+        def post(body, path="/screen"):
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            try:
+                conn.request("POST", path, body=json.dumps(body),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+
+        index = ChainIndex.open(index_dir)
+        cid = index.chain_ids()[0]
+        status, out = post({"indexed": True, "query": cid, "top_m": 4})
+        assert status == 200
+        assert out["indexed"] and out["query"] == cid
+        assert out["chains"] == index.num_chains
+        assert out["candidates"] == index.num_chains - 1 > 3
+        assert out["survivors"] == out["pairs_decoded"] == 4
+        assert len(out["ranked"]) == 4 and not out["partial"]
+        scores = [r["score"] for r in out["ranked"]]
+        assert scores == sorted(scores, reverse=True)
+        assert out["weights_signature"] == engine.weights_signature()
+        assert out["partitions_served"] == index.partition_ids()
+
+        # Partition-scoped sub-request (what the router's fan-out
+        # sends): candidates come from the named partitions only.
+        pid = index.partition_ids()[0]
+        status, sub = post({"indexed": True, "query": cid, "top_m": 0,
+                            "partitions": [pid]})
+        assert status == 200 and sub["partitions_served"] == [pid]
+        in_part = set(index.partition(pid)["chains"]) - {cid}
+        assert {r["partner"] for r in sub["ranked"]} == in_part
+
+        # The classic path keeps its refusal: the limit was LIFTED for
+        # indexed libraries, not dropped.
+        status, err = post({"npz_paths": ["/nope.npz"]})
+        assert status == 400
+        status, err = post({"indexed": True, "query": "ghost-chain"})
+        assert status == 400  # KeyError from chain_feats -> client error
+        status, err = post({"index_path": "/nope/index", "query": cid})
+        assert status == 400 and "index" in err["error"]
+    finally:
+        srv.httpd.shutdown()
+        srv.httpd.server_close()
+
+
+def test_http_indexed_screen_partial_flush_under_deadline(
+        engine, built_index):
+    """Deadline expiry mid-decode flushes the partners ranked so far
+    with partial=true (200), never a 504 with nothing."""
+    import http.client
+
+    from deepinteract_tpu.serving import ServingServer
+
+    index_dir, _ = built_index
+    srv = ServingServer(engine, port=0, index_path=index_dir)
+    srv.serve_background()
+    try:
+        host, port = srv.address
+        index = ChainIndex.open(index_dir)
+        cid = index.chain_ids()[0]
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        try:
+            # decode_batch == engine max_batch == 8; 9 survivors need 2+
+            # dispatches, and an already-expired deadline stops the
+            # funnel at the FIRST batch boundary: zero decoded, partial.
+            conn.request(
+                "POST", "/screen",
+                body=json.dumps({"indexed": True, "query": cid,
+                                 "top_m": index.num_chains}),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Deadline-Ms": "0.001"})
+            resp = conn.getresponse()
+            status, out = resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+        assert status == 200
+        assert out["partial"] is True
+        assert out["pairs_decoded"] < out["survivors"]
+    finally:
+        srv.httpd.shutdown()
+        srv.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Router fan-out over stub workers (real processes, SIGKILL mid-query)
+# ---------------------------------------------------------------------------
+
+
+def _fake_manifest(index_dir, chains_per_part=3, parts=6,
+                   weights_signature="v1"):
+    """A manifest-only index (no shards): enough for the router (it
+    reads ONLY the partition table) and the stub workers' deterministic
+    indexed /screen."""
+    partitions = []
+    cnum = 0
+    for seq in range(parts):
+        pid = idx_format.partition_id(64, seq)
+        cids = [f"c{cnum + i:03d}" for i in range(chains_per_part)]
+        cnum += chains_per_part
+        partitions.append({"partition_id": pid,
+                           "file": f"partitions/{pid}.npz",
+                           "bucket": 64, "chains": cids,
+                           "lengths": [20] * chains_per_part})
+    idx_format.write_manifest(index_dir, {
+        "format_version": idx_format.INDEX_FORMAT_VERSION,
+        "weights_signature": weights_signature,
+        "library_signature": "stub-lib",
+        "input_indep": False, "compute_dtype": "float32",
+        "feat_dim": 8, "partition_size": chains_per_part,
+        "num_chains": cnum, "partitions": partitions})
+    return [p["partition_id"] for p in partitions], cnum
+
+
+def test_router_indexed_fanout_scatter_gather(tmp_path):
+    from tests.test_fleet import make_fleet, post
+
+    index_dir = str(tmp_path / "stub_index")
+    pids, num_chains = _fake_manifest(index_dir)
+    sup, router = make_fleet(tmp_path, n=2)
+    try:
+        host, port = router.address
+        body = json.dumps({"index_path": index_dir, "query": "c000",
+                           "top_m": 0}).encode()
+        status, out, headers = post(host, port, path="/screen",
+                                    body=body, timeout=30.0)
+        rec = json.loads(out)
+        assert status == 200
+        assert rec["indexed"] and rec["query"] == "c000"
+        assert rec["chains"] == num_chains
+        assert rec["partitions_served"] == pids  # every partition served
+        assert rec["fanout_groups"] == 2  # genuinely scattered (6 pids
+        # over 2 workers: crc32 affinity lands 4 on one slot, 2 on the
+        # other)
+        assert rec["failed_groups"] == 0 and not rec["partial"]
+        assert int(headers["X-DI-Fanout"]) == rec["fanout_groups"]
+        # Gather re-ranks the merged survivors globally.
+        assert rec["candidates"] == num_chains - 1
+        assert len(rec["ranked"]) == num_chains - 1
+        scores = [r["score"] for r in rec["ranked"]]
+        assert scores == sorted(scores, reverse=True)
+        assert "c000" not in {r["partner"] for r in rec["ranked"]}
+        # Both workers answered (partition affinity spreads groups).
+        assert len({r["partition_id"] for r in rec["ranked"]}) == len(pids)
+
+        status, out, _ = post(
+            host, port, path="/screen",
+            body=json.dumps({"index_path": "/nope", "query": "x"}).encode())
+        assert status == 400
+    finally:
+        router.drain()
+
+
+def test_router_indexed_fanout_survives_worker_sigkill(tmp_path):
+    """ISSUE-17 acceptance: a worker SIGKILL'd mid-query moves its
+    partition groups to a sibling through the route-level failover — the
+    merged answer still covers every partition."""
+    from tests.test_fleet import make_fleet, post, wait_routable
+
+    index_dir = str(tmp_path / "stub_index")
+    pids, num_chains = _fake_manifest(index_dir)
+    # Slow workers (1.2s in-flight window) so the kill lands mid-query.
+    sup, router = make_fleet(tmp_path, n=2,
+                             overrides={"delay_ms": 1200})
+    try:
+        host, port = router.address
+        body = json.dumps({"index_path": index_dir, "query": "c000",
+                           "top_m": 0}).encode()
+        result = {}
+
+        def run_query():
+            status, out, _ = post(host, port, path="/screen", body=body,
+                                  timeout=60.0)
+            result["status"], result["out"] = status, out
+
+        t = threading.Thread(target=run_query)
+        t.start()
+        time.sleep(0.4)  # sub-requests are now in the stubs' sleep
+        victim = sup.worker_infos()[0]
+        os.kill(victim["pid"], signal.SIGKILL)
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+        assert result["status"] == 200
+        rec = json.loads(result["out"])
+        assert rec["partitions_served"] == pids  # nothing lost
+        assert rec["failed_groups"] == 0
+        assert len(rec["ranked"]) == num_chains - 1
+        wait_routable(sup, 2)  # supervisor restarts the victim
+    finally:
+        router.drain()
+
+
+# ---------------------------------------------------------------------------
+# CLI kill -9 resume (slow tier: real subprocess, real ledger)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_build_kill9_resumes_exactly_once(tmp_path):
+    index_dir = str(tmp_path / "index")
+    argv = [sys.executable, "-m", "deepinteract_tpu.cli.index", "build",
+            *TINY_CLI_ARGS, "--synthetic_chains", "10",
+            "--synthetic_len", "20,40", "--screen_batch", "4",
+            "--index_dir", index_dir, "--partition_size", "2"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    ledger = idx_format.ledger_path(index_dir)
+    deadline = time.monotonic() + 300.0
+    while time.monotonic() < deadline:
+        if os.path.exists(ledger) and json.loads(
+                open(ledger).read()).get("completed"):
+            break
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"build finished before the kill landed:\n"
+                f"{proc.stdout.read().decode()}")
+        time.sleep(0.1)
+    else:
+        raise AssertionError("build never completed a partition")
+    proc.kill()  # SIGKILL: no atexit, no flush, mid-build
+    proc.wait(timeout=30)
+
+    done_before = len(json.loads(open(ledger).read())["completed"])
+    assert done_before >= 1
+    out = subprocess.run(argv, env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["ok"] and rec["resumed"]
+    assert rec["partitions_resumed"] >= done_before
+    assert rec["partitions_resumed"] + rec.get("partitions_rebuilt", 0) \
+        >= done_before
+    assert verify_index(index_dir)["ok"]
+    # Exactly-once across the kill: resumed + built = total.
+    assert rec["partitions"] == rec["partitions_resumed"] + (
+        rec["partitions"] - rec["partitions_resumed"])
+    assert ChainIndex.open(index_dir).num_chains == 10

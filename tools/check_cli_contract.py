@@ -97,16 +97,20 @@ CONTRACTS = {
     # fleet_versions + stale_version_ledgers are the ISSUE-16 additions:
     # per-version worker counts from fleet_state.json, and agreement
     # ledgers no weighted/shadowed version can consume.
+    # index_partitions + stale_index_partitions are the ISSUE-17
+    # additions: proteome-index partition census, and manifests frozen
+    # at a weights_signature no healthy fleet worker serves.
     "fsck": {
         "required": ("schema", "metric", "value", "unit", "ok", "root",
                      "scanned", "verified", "unverified", "corrupt",
                      "quarantined", "tmp_files", "corrupt_paths",
                      "stale_heartbeats", "stale_heartbeat_hosts",
                      "resume_cursor", "fleet_versions",
-                     "stale_version_ledgers"),
+                     "stale_version_ledgers", "index_partitions",
+                     "stale_index_partitions"),
         "numeric": ("value", "scanned", "verified", "unverified",
                     "corrupt", "quarantined", "tmp_files",
-                    "stale_heartbeats"),
+                    "stale_heartbeats", "index_partitions"),
     },
     # sustained/v1: tools/sustained_train.py — end-to-end sustained
     # training rate, the device-resident scanned micro-bench it is
@@ -120,6 +124,31 @@ CONTRACTS = {
         "numeric": ("value", "ratio_vs_scan", "scan_complexes_per_sec",
                     "epochs", "n_train", "steady_epoch_s",
                     "steps_per_dispatch"),
+    },
+    # index/v1: python -m deepinteract_tpu.cli.index build|verify|merge
+    # (the proteome-index lifecycle; deepinteract_tpu/index).
+    "index": {
+        "required": ("schema", "metric", "value", "unit", "ok", "action",
+                     "index_dir", "partitions", "chains", "buckets",
+                     "weights_signature", "library_signature", "resumed",
+                     "partitions_resumed", "partitions_rebuilt",
+                     "encodes_executed", "corrupt", "corrupt_paths",
+                     "preempted", "elapsed_s"),
+        "numeric": ("value", "partitions", "chains",
+                    "partitions_resumed", "partitions_rebuilt",
+                    "encodes_executed", "corrupt", "elapsed_s"),
+    },
+    # query/v1: python -m deepinteract_tpu.cli.query (single-box ranked-
+    # partner funnel over a prebuilt index; index/funnel.py).
+    "query": {
+        "required": ("schema", "metric", "value", "unit", "ok", "query",
+                     "index_dir", "chains", "candidates", "top_m",
+                     "survivors", "pairs_decoded", "decode_batches",
+                     "prefilter_survivor_frac", "partial", "ranked_out",
+                     "elapsed_s", "top_partner"),
+        "numeric": ("value", "chains", "candidates", "top_m",
+                    "survivors", "pairs_decoded", "decode_batches",
+                    "prefilter_survivor_frac", "elapsed_s"),
     },
     # train_supervise/v1: cli/train.py --supervise (training/
     # supervisor.py TrainingSupervisor.contract): supervised restarts,

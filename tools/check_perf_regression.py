@@ -62,6 +62,15 @@ TOLERANCES = {
     "screening.naive_pairs_per_sec": (0.35, +1),
     "screening.speedup_vs_naive": (0.35, +1),
     "screening.encode_reuse_ratio": (0.10, +1),
+    # Proteome-index funnel contract (bench `screening.indexed`
+    # subsection, ISSUE-17): ranked-partner throughput against a
+    # prebuilt partitioned index (candidate pairs retired per second of
+    # query wall — pre-filter reject or survivor decode) and the
+    # end-to-end query latency an indexed /screen caller sees.
+    # prefilter_survivor_frac is provenance (it is top_m/candidates by
+    # construction), not gated.
+    "screening.indexed.indexed_pairs_per_sec": (0.35, +1),
+    "screening.indexed.query_p50_ms": (0.50, -1),
     "attribution.total_device_ms": (0.50, -1),
     # Overload-safety contract (bench `saturation` section, ISSUE-11):
     # the p99 ratio is the bounded-queue promise (lower = tighter tail
