@@ -101,6 +101,9 @@ SECTION_EST_S = {
     # +~110s for the ISSUE-17 indexed subsection (1k-chain build + 3
     # funnel queries at top_m=8, CPU rehearsal numbers).
     "screening": 420,
+    # k=6 assembly through the real AssemblyRunner: 15 pairs warm +
+    # 15 measured, decode-dominated (CPU rehearsal ~1.8s/pair flagship).
+    "assembly": 240,
     "input_pipeline": 420,
     "saturation": 240,
     "rollover": 180,
@@ -585,8 +588,8 @@ def _section_names(platform: str) -> list:
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
              "b1_p256", "b1_p384_tiled", "eval_path", "screening",
-             "saturation", "rollover", "elasticity", "recovery",
-             "attribution", "input_pipeline"]
+             "assembly", "saturation", "rollover", "elasticity",
+             "recovery", "attribution", "input_pipeline"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -1220,6 +1223,74 @@ def _run_screening_section(ctx, detail) -> None:
     finally:
         engine.close()
     _log(json.dumps({"screening": entry}))
+    _dump_partial(detail)
+
+
+def _run_assembly_section(ctx, detail) -> None:
+    """k-chain assembly throughput (ISSUE-19): one complex of
+    ``DI_BENCH_ASM_CHAINS`` chains through the real AssemblyRunner —
+    C(k,2) canonical-oriented pairs, each unique chain encoded EXACTLY
+    once, decodes micro-batched through the engine's AOT inventory, and
+    the interface graph assembled at the end.
+
+    Protocol mirrors the screening section: a full warm-up assemble
+    first pays every encode/decode compile (throwaway embedding cache),
+    then the measured assemble runs with a FRESH cache so the
+    steady-state figure includes its k cold encodes — and so
+    ``unique_encodes`` lands at exactly k, the encode-once invariant
+    tools/check_perf_regression.py gates as an absolute ceiling
+    (``assembly.chains`` is the contract-carried bar: any growth means
+    a pair re-encoded a chain and O(k) silently became O(k^2)). The
+    control pass is off here — it doubles the decode bill and its
+    scientific value (input-independence) is asserted end-to-end by the
+    CLI/serving tests, not a throughput row."""
+    import time as _time
+
+    from deepinteract_tpu.assembly import AssemblyConfig, AssemblyRunner
+    from deepinteract_tpu.screening import ChainLibrary, EmbeddingCache
+    from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+
+    k = int(os.environ.get("DI_BENCH_ASM_CHAINS", "6"))
+    library = ChainLibrary.synthetic(k, 40, 60, seed=17)
+    engine = InferenceEngine(
+        ctx["make_model"]().cfg,
+        cfg=EngineConfig(max_batch=8, result_cache_size=0))
+    entry = {"chains": k,
+             "interaction_stem": engine.model.cfg.interaction_stem,
+             "compute_dtype": ctx["bench_dtype"]}
+    detail["assembly"] = entry
+    try:
+        cfg = AssemblyConfig(top_k=10, decode_batch=8, encode_batch=8,
+                             control=False, keep_maps=False)
+        # Warm-up assemble: pays every encode/decode compile.
+        AssemblyRunner(engine, cache=EmbeddingCache(), cfg=cfg).assemble(
+            library)
+        entry["compile_inventory"] = dict(
+            engine.stats()["compiled_buckets"])
+        _dump_partial(detail)
+
+        # Measured assemble, fresh cache: k cold encodes + C(k,2)
+        # decodes — the steady-state cost of scoring one new complex.
+        runner = AssemblyRunner(engine, cache=EmbeddingCache(), cfg=cfg)
+        t0 = _time.perf_counter()
+        result = runner.assemble(library)
+        elapsed = _time.perf_counter() - t0
+        entry["pairs"] = result.pairs_total
+        entry["pairs_per_sec"] = round(result.pairs_scored / elapsed, 3)
+        entry["unique_encodes"] = result.unique_encodes
+        entry["encode_cache_hits"] = result.encode_cache_hits
+        entry["decode_batches"] = result.decode_batches
+        entry["interface_edges"] = len(result.interface["edges"])
+        entry["encode_seconds"] = round(result.encode_seconds, 3)
+        entry["decode_seconds"] = round(result.decode_seconds, 3)
+        entry["elapsed_s"] = round(elapsed, 3)
+        if result.unique_encodes > result.chains:
+            raise RuntimeError(
+                f"encode-once violated: {result.unique_encodes} encodes "
+                f"for {result.chains} chains")
+    finally:
+        engine.close()
+    _log(json.dumps({"assembly": entry}))
     _dump_partial(detail)
 
 
@@ -2112,8 +2183,8 @@ def _section_result_key(name: str):
     if name == "eval_path":
         return None, "eval_path_b128"
     if name in ("tuned_ab", "stem_ab", "precision_ab", "screening",
-                "saturation", "rollover", "elasticity", "recovery",
-                "attribution", "input_pipeline"):
+                "assembly", "saturation", "rollover", "elasticity",
+                "recovery", "attribution", "input_pipeline"):
         return None, name
     if name.startswith("ab_p"):
         return None, f"attention_ab_b1_p{name[4:]}"
@@ -2144,6 +2215,8 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_precision_ab_section(ctx, detail)
     elif name == "screening":
         _run_screening_section(ctx, detail)
+    elif name == "assembly":
+        _run_assembly_section(ctx, detail)
     elif name == "saturation":
         _run_saturation_section(ctx, detail)
     elif name == "rollover":
@@ -2347,6 +2420,17 @@ def _build_headline(detail, scan_k) -> dict:
                 for k in ("indexed_pairs_per_sec", "query_p50_ms",
                           "prefilter_survivor_frac", "chains", "top_m")
                 if k in idx}
+    assembly = detail.get("assembly", {})
+    if "pairs_per_sec" in assembly:
+        # Assembly contract keys (ISSUE-19): k-chain complex scoring
+        # throughput and the encode-once invariant (unique_encodes <=
+        # chains — the contract carries its own ceiling). Both gated in
+        # tools/check_perf_regression.py.
+        line["assembly"] = {
+            k: assembly[k]
+            for k in ("pairs_per_sec", "unique_encodes", "chains",
+                      "pairs", "decode_batches", "interface_edges")
+            if k in assembly}
     if _is_partial(detail):
         # Sections were skipped/failed under the wall budget: the record
         # says so itself instead of looking complete-but-thin.
@@ -2364,8 +2448,8 @@ def _is_partial(detail) -> bool:
     candidates += [v for k, v in detail.items()
                    if k.startswith(("attention_ab", "eval_path", "tuned_ab",
                                     "stem_ab", "precision_ab", "screening",
-                                    "saturation", "rollover", "elasticity",
-                                    "recovery",
+                                    "assembly", "saturation", "rollover",
+                                    "elasticity", "recovery",
                                     "attribution", "input_pipeline"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates

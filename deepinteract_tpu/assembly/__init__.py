@@ -1,0 +1,25 @@
+"""k-chain assembly scoring (PR-19).
+
+A complex with k chains has C(k, 2) chain pairs; this package scores
+all of them with one encoder pass per UNIQUE chain (the PR-6 embedding
+cache, counter-asserted), micro-batched contact decodes through the
+engine's existing AOT inventory, and assembles the per-assembly result:
+per-pair contact maps, an interface graph (edges = pairs whose
+calibrated interaction score clears a threshold), a complex-level
+interactability score, and the ``input_indep`` control score — the
+wired-in honesty baseline every ranking is reported next to.
+"""
+
+from deepinteract_tpu.assembly.runner import (
+    ASSEMBLY_BUNDLE_KIND,
+    AssemblyConfig,
+    AssemblyResult,
+    AssemblyRunner,
+)
+
+__all__ = [
+    "ASSEMBLY_BUNDLE_KIND",
+    "AssemblyConfig",
+    "AssemblyResult",
+    "AssemblyRunner",
+]

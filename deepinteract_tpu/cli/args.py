@@ -468,6 +468,42 @@ def add_screening_args(p: argparse.ArgumentParser) -> None:
                         "existing matching manifest resumes the screen)")
 
 
+def add_calibration_args(p: argparse.ArgumentParser) -> None:
+    """Calibration-consumption surface shared by predict/screen/query/
+    assemble/serve (deepinteract_tpu.calibration): point any scoring
+    entry point at a fitted artifact and calibrated probabilities ride
+    NEXT TO the raw ones (never instead of them)."""
+    g = p.add_argument_group("calibration")
+    g.add_argument("--calibration", type=str, default=None,
+                   help="fitted calibration artifact (cli/calibrate.py "
+                        "output); verified against the served weights' "
+                        "signature before use — a map fitted for other "
+                        "weights is refused as stale")
+    g.add_argument("--allow_stale_calibration", action="store_true",
+                   help="apply a calibration whose weights_signature "
+                        "does not match the engine (integrity is still "
+                        "verified; the probabilities may be garbage — "
+                        "format debugging only)")
+
+
+def add_assembly_args(p: argparse.ArgumentParser) -> None:
+    """k-chain assembly surface (cli/assemble.py;
+    deepinteract_tpu.assembly)."""
+    g = p.add_argument_group("assembly")
+    g.add_argument("--edge_threshold", type=float, default=0.5,
+                   help="interface-graph edge cut: pairs whose "
+                        "calibrated interaction score (raw score when "
+                        "no --calibration) reaches this become edges")
+    g.add_argument("--no_control", action="store_true",
+                   help="skip the input_indep control pass (the zeroed-"
+                        "features honesty baseline reported next to "
+                        "every assembly score)")
+    g.add_argument("--no_maps", action="store_true",
+                   help="do not persist the per-pair contact maps "
+                        "(<out>.npz); rankings and the interface graph "
+                        "are still written")
+
+
 def add_index_args(p: argparse.ArgumentParser) -> None:
     """Proteome-index surface (cli/index.py, cli/query.py;
     deepinteract_tpu.index)."""

@@ -54,6 +54,18 @@ HEARTBEAT_MAX_AGE_S = 300.0
 INDEX_MANIFEST_BASENAME = "index_manifest.json"
 INDEX_SHARD_PREFIX = "part-"
 
+# Calibration artifacts (deepinteract_tpu/calibration/calibrator.py) and
+# assembly bundles (cli/assemble.py). All three writers go through
+# atomic_write_artifact, so a naked file is a stray — sidecar REQUIRED.
+CALIBRATION_BASENAME = "calibration.json"
+CALIBRATION_SUFFIX = ".calibration.json"
+ASSEMBLY_BUNDLE_SUFFIX = ".assembly.json"
+ASSEMBLY_MAPS_SUFFIX = ".maps.npz"
+
+
+def _is_calibration(name: str) -> bool:
+    return name == CALIBRATION_BASENAME or name.endswith(CALIBRATION_SUFFIX)
+
 
 def _known_json_artifact(name: str) -> bool:
     # Heartbeats are per-process files: obs/heartbeat_p<N>.json
@@ -345,6 +357,100 @@ def _check_index_manifest(path: str, report: Dict) -> None:
     })
 
 
+def _check_calibration(path: str, report: Dict) -> None:
+    """Census a fitted calibration map (calibration/calibrator.py
+    ``save_calibration``). Byte integrity is covered by the sidecar
+    check above; here the structure is validated (a malformed map would
+    400 every ``--calibration`` run at load) and the weights_signature
+    is collected so ``main`` can cross-reference against the served
+    fleet versions — a calibration fitted for weights NO healthy worker
+    serves is promotion debt, exactly like a stale index partition:
+    applying it silently mis-scales the successor model's
+    probabilities."""
+    if any(e["path"] == path for e in report["corrupt_paths"]):
+        return  # integrity layer already flagged (and maybe moved) it
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return  # already flagged by the parse checks above
+    if not isinstance(payload, dict):
+        return
+    problems = []
+    sig = payload.get("weights_signature")
+    if not isinstance(sig, str) or not sig:
+        problems.append("weights_signature missing")
+    if payload.get("schema") != "calibration/v1":
+        problems.append(f"schema is {payload.get('schema')!r}, "
+                        "want 'calibration/v1'")
+    method = payload.get("method")
+    if method not in ("temperature", "isotonic", "identity"):
+        problems.append(f"method {method!r} unknown")
+    elif method == "temperature":
+        t = payload.get("temperature")
+        if not isinstance(t, (int, float)) or isinstance(t, bool) or t <= 0:
+            problems.append("temperature is not a positive number")
+    elif method == "isotonic":
+        xs, ys = payload.get("iso_x"), payload.get("iso_y")
+        if (not isinstance(xs, list) or not isinstance(ys, list)
+                or len(xs) != len(ys) or not xs):
+            problems.append("isotonic knots missing or mismatched")
+    if problems:
+        _mark_corrupt(path, "calibration malformed: " + "; ".join(problems),
+                      "calibration", report)
+        return
+    report.setdefault("calibrations", []).append({
+        "path": path, "weights_signature": sig, "method": method,
+    })
+
+
+def _check_assembly_bundle(path: str, report: Dict) -> None:
+    """Validate an assembly bundle manifest (cli/assemble.py): the
+    interface graph must be structurally sound and every output file it
+    references (ranked jsonl, maps npz) must still exist beside it — a
+    bundle pointing at deleted outputs is a torn hand-off, flagged as
+    corruption so ``--quarantine`` moves it aside rather than letting a
+    downstream consumer trust a dangling manifest."""
+    if any(e["path"] == path for e in report["corrupt_paths"]):
+        return  # integrity layer already flagged (and maybe moved) it
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return  # already flagged by the parse checks above
+    if not isinstance(payload, dict):
+        return
+    problems = []
+    sig = payload.get("weights_signature")
+    if not isinstance(sig, str) or not sig:
+        problems.append("weights_signature missing")
+    if payload.get("schema") != "assembly-bundle/v1":
+        problems.append(f"schema is {payload.get('schema')!r}, "
+                        "want 'assembly-bundle/v1'")
+    interface = payload.get("interface")
+    if (not isinstance(interface, dict)
+            or not isinstance(interface.get("nodes"), list)
+            or not isinstance(interface.get("edges"), list)):
+        problems.append("interface is not a nodes/edges graph")
+    files = payload.get("files")
+    if not isinstance(files, dict) or not isinstance(
+            files.get("ranked"), str):
+        problems.append("files.ranked missing")
+    else:
+        bundle_dir = os.path.dirname(path)
+        missing = [v for v in (files.get("ranked"), files.get("maps"))
+                   if isinstance(v, str)
+                   and not os.path.exists(os.path.join(bundle_dir, v))]
+        if missing:
+            problems.append("bundle references missing outputs: "
+                            + ", ".join(missing))
+    if problems:
+        _mark_corrupt(path, "assembly bundle malformed: "
+                      + "; ".join(problems), "assembly-bundle", report)
+        return
+    report["assembly_bundles"] = report.get("assembly_bundles", 0) + 1
+
+
 def _mark_corrupt(path: str, reason: str, kind: str, report: Dict) -> None:
     report["corrupt_paths"].append({"path": path, "kind": kind,
                                     "reason": reason})
@@ -395,12 +501,21 @@ def scan(root: str, do_quarantine: bool, do_sweep: bool) -> Dict:
             shard = (name.startswith(INDEX_SHARD_PREFIX)
                      and name.endswith(".npz"))
             idx_manifest = name == INDEX_MANIFEST_BASENAME
-            if (has_sidecar or spill or shard or idx_manifest
+            calibration = _is_calibration(name)
+            bundle = name.endswith(ASSEMBLY_BUNDLE_SUFFIX)
+            asm_maps = name.endswith(ASSEMBLY_MAPS_SUFFIX)
+            sidecar_required = (spill or shard or idx_manifest
+                                or calibration or bundle or asm_maps)
+            if (has_sidecar or sidecar_required
                     or _known_json_artifact(name)):
                 _check_file(path, report,
-                            require_sidecar=spill or shard or idx_manifest)
+                            require_sidecar=sidecar_required)
             if idx_manifest:
                 _check_index_manifest(path, report)
+            if calibration:
+                _check_calibration(path, report)
+            if bundle:
+                _check_assembly_bundle(path, report)
             if name == "trainer_state.json":
                 _check_trainer_cursor(path, report)
             if name == "fleet_state.json":
@@ -473,6 +588,18 @@ def main(argv=None) -> int:
                 print(f"stale index partitions ({m['partitions']} @ "
                       f"weights {m['weights_signature']}, served "
                       f"versions {sorted(served)}): {m['path']}")
+    # Same promotion-debt rule for calibrations: a fitted map whose
+    # frozen weights_signature matches no served version would silently
+    # mis-scale whatever model replaced those weights. Judged only
+    # against a fleet census found in the scanned tree.
+    stale_cal = []
+    if served:
+        for c in report.get("calibrations", []):
+            if c["weights_signature"] not in served:
+                stale_cal.append(c["path"])
+                print(f"stale calibration ({c['method']} @ weights "
+                      f"{c['weights_signature']}, served versions "
+                      f"{sorted(served)}): {c['path']}")
     for path in report["tmp_paths"]:
         swept = " (swept)" if (args.sweep_tmp or args.quarantine) else ""
         print(f"orphan tmp: {path}{swept}")
@@ -502,6 +629,9 @@ def main(argv=None) -> int:
         "stale_version_ledgers": report.get("stale_version_ledgers", []),
         "index_partitions": report.get("index_partitions", 0),
         "stale_index_partitions": stale_index,
+        "calibrations": len(report.get("calibrations", [])),
+        "stale_calibrations": stale_cal,
+        "assembly_bundles": report.get("assembly_bundles", 0),
         "tmp_files": len(report["tmp_paths"]),
         "tmp_swept": report["tmp_swept"],
         "corrupt_paths": [e["path"] for e in report["corrupt_paths"][:20]],

@@ -21,11 +21,16 @@ import sys
 
 import numpy as np
 
-from deepinteract_tpu.cli.args import build_parser, configs_from_args
+from deepinteract_tpu.cli.args import (
+    add_calibration_args,
+    build_parser,
+    configs_from_args,
+)
 
 
 def main(argv=None) -> int:
     parser = build_parser(__doc__)
+    add_calibration_args(parser)
     parser.add_argument("--input_npz", type=str, default=None,
                         help="complex .npz (see deepinteract_tpu.data.io)")
     parser.add_argument("--left_pdb", type=str, default=None,
@@ -44,6 +49,18 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if not args.input_npz and not (args.left_pdb and args.right_pdb):
         parser.error("provide --input_npz or both --left_pdb and --right_pdb")
+
+    cal = None
+    if args.calibration:
+        # Verify the artifact BEFORE paying for model construction: a
+        # stale or corrupt calibration refuses in milliseconds instead
+        # of after a full forward pass.
+        from deepinteract_tpu.calibration import load_calibration
+
+        cal = load_calibration(
+            args.calibration,
+            expect_signature=(args.ckpt_name or f"init-seed{args.seed}"),
+            allow_stale=args.allow_stale_calibration)
 
     import jax
 
@@ -119,11 +136,21 @@ def main(argv=None) -> int:
         from deepinteract_tpu.robustness import artifacts
 
         summary = pair_summary(probs, args.top_k)
+        if cal is not None:
+            # Calibrated probabilities ride NEXT TO the raw ones — the
+            # raw score/max_prob/p keys never change meaning.
+            ps = np.asarray([c["p"] for c in summary["top_contacts"]],
+                            dtype=np.float64)
+            cal_ps = cal.apply(ps)
+            for c, p_cal in zip(summary["top_contacts"], cal_ps):
+                c["p_cal"] = round(float(p_cal), 6)
+            summary["calibrated_score"] = round(float(cal_ps.mean()), 6)
+            summary["calibration"] = args.calibration
         contacts_path = os.path.join(args.output_dir, "top_contacts.json")
         artifacts.atomic_write(contacts_path, json.dumps(summary, indent=1))
         # Final stdout line is machine-readable, mirroring screen/tune/
         # bench contract discipline (tools/check_cli_contract.py).
-        print(json.dumps({
+        line = {
             "metric": "pair_score_topk_mean",
             "value": round(summary["score"], 6),
             "unit": "probability",
@@ -132,7 +159,11 @@ def main(argv=None) -> int:
             "n1": n1, "n2": n2,
             "top_contacts_out": contacts_path,
             "contact_map_out": out,
-        }), flush=True)
+        }
+        if args.calibration:
+            line["calibrated_score"] = summary["calibrated_score"]
+            line["calibration"] = args.calibration
+        print(json.dumps(line), flush=True)
     return 0
 
 

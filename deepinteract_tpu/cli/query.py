@@ -27,6 +27,7 @@ import sys
 import time
 
 from deepinteract_tpu.cli.args import (
+    add_calibration_args,
     add_index_args,
     add_screening_args,
     build_parser,
@@ -49,6 +50,7 @@ def main(argv=None) -> int:
     parser = build_parser(__doc__)
     add_screening_args(parser)
     add_index_args(parser)
+    add_calibration_args(parser)
     args = parser.parse_args(argv)
     if not args.query or "," in args.query:
         raise SystemExit("--query must name exactly one chain id")
@@ -87,6 +89,16 @@ def main(argv=None) -> int:
         seed=args.seed,
         metric_to_track=args.metric_to_track,
     )
+    calibrator = None
+    if args.calibration:
+        from deepinteract_tpu.calibration import load_calibration
+
+        calibrator = load_calibration(
+            args.calibration,
+            expect_signature=engine.weights_signature(),
+            allow_stale=args.allow_stale_calibration)
+        print(f"query: calibration {args.calibration} "
+              f"({calibrator.method})", flush=True)
     try:
         runner = IndexedQueryRunner(
             engine, index,
@@ -110,6 +122,10 @@ def main(argv=None) -> int:
     finally:
         engine.close()
 
+    if calibrator is not None:
+        from deepinteract_tpu.calibration.calibrator import annotate_records
+
+        annotate_records(result.records, calibrator)
     ranked_out = write_ranked(args.out, result.records)
     latency_ms = elapsed * 1e3
     contract = {
@@ -136,6 +152,9 @@ def main(argv=None) -> int:
              for k in ("partner", "score", "prefilter_score")}
             if result.records else None),
     }
+    if calibrator is not None:
+        contract["calibration"] = args.calibration
+        contract["calibrated"] = True
     # FINAL stdout line = the machine-readable contract
     # (tools/check_cli_contract.py keeps this un-regressable).
     print(json.dumps(contract), flush=True)

@@ -32,6 +32,7 @@ import sys
 from deepinteract_tpu.robustness import artifacts
 
 from deepinteract_tpu.cli.args import (
+    add_calibration_args,
     add_screening_args,
     build_parser,
     configs_from_args,
@@ -79,6 +80,7 @@ def write_outputs(out_prefix: str, records) -> dict:
 def main(argv=None) -> int:
     parser = build_parser(__doc__)
     add_screening_args(parser)
+    add_calibration_args(parser)
     args = parser.parse_args(argv)
 
     import time
@@ -138,12 +140,27 @@ def main(argv=None) -> int:
         print(f"screen: resuming — {len(manifest.completed)}/{len(pairs)} "
               f"pairs already scored in {manifest_path}", flush=True)
 
+    calibrator = None
+    if args.calibration:
+        from deepinteract_tpu.calibration import load_calibration
+
+        calibrator = load_calibration(
+            args.calibration,
+            expect_signature=engine.weights_signature(),
+            allow_stale=args.allow_stale_calibration)
+        print(f"screen: calibration {args.calibration} "
+              f"({calibrator.method})", flush=True)
+
     t0 = time.perf_counter()
     with PreemptionGuard(log=lambda m: print(m, flush=True)) as guard:
         result = runner.screen(library, pairs, manifest=manifest,
                                guard=guard)
     elapsed = time.perf_counter() - t0
 
+    if calibrator is not None:
+        from deepinteract_tpu.calibration.calibrator import annotate_records
+
+        annotate_records(result.records, calibrator)
     paths = write_outputs(args.out, result.records)
     if result.preempted:
         print(f"screen: preempted with {result.pairs_scored} pairs scored "
@@ -172,6 +189,9 @@ def main(argv=None) -> int:
              for k in ("pair_id", "score", "max_prob")}
             if result.records else None),
     }
+    if calibrator is not None:
+        contract["calibration"] = args.calibration
+        contract["calibrated"] = True
     # FINAL stdout line = the machine-readable contract
     # (tools/check_cli_contract.py keeps this un-regressable).
     print(json.dumps(contract), flush=True)

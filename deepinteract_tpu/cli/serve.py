@@ -252,6 +252,9 @@ def _versions_main(args) -> int:
 def main(argv=None, guard=None) -> int:
     parser = build_parser(__doc__)
     add_serving_args(parser)
+    from deepinteract_tpu.cli.args import add_calibration_args
+
+    add_calibration_args(parser)
     args = parser.parse_args(argv)
 
     if args.rollover:
@@ -341,6 +344,7 @@ def main(argv=None, guard=None) -> int:
         screen_max_pairs=args.screen_max_pairs,
         default_deadline_ms=args.default_deadline_ms,
         index_path=args.index_path,
+        calibration_path=args.calibration,
         shedder_cfg=ShedderConfig(
             enabled=not args.no_load_shedding,
             enter_utilization=args.shed_enter_util,

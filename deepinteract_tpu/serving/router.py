@@ -4,8 +4,8 @@ The router is the one address clients know. Behind it, a
 :class:`~deepinteract_tpu.serving.fleet.WorkerSupervisor` keeps N
 single-engine workers alive; the router:
 
-* **routes** — ``POST /predict`` / ``POST /screen`` are proxied to a
-  healthy worker. Same-bucket requests stick to the same worker while
+* **routes** — ``POST /predict`` / ``POST /screen`` / ``POST
+  /assembly`` are proxied to a healthy worker. Same-bucket requests stick to the same worker while
   the fleet is stable (an ``X-DI-Bucket`` hint is hashed onto the active
   list, so a bucket's compile cache and micro-batch coalescing stay
   warm on ONE worker) and fall back to round-robin without a hint. The
@@ -216,9 +216,10 @@ class FleetRouter:
             def _send_body(self, code: int, body: bytes, ctype: str,
                            extra: Optional[Dict[str, str]] = None) -> None:
                 _ROUTED.inc(endpoint=endpoint_label(
-                    self.path, ("/predict", "/screen", "/healthz",
-                                "/stats", "/metrics", "/admin/rollover",
-                                "/admin/versions", "/admin/promote")),
+                    self.path, ("/predict", "/screen", "/assembly",
+                                "/healthz", "/stats", "/metrics",
+                                "/admin/rollover", "/admin/versions",
+                                "/admin/promote")),
                     status=str(code))
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
@@ -260,7 +261,7 @@ class FleetRouter:
                 if route == "/admin/promote":
                     self._do_promote(body)
                     return
-                if route not in ("/predict", "/screen"):
+                if route not in ("/predict", "/screen", "/assembly"):
                     self._send_json(404, {"error": f"no route {route}"})
                     return
                 if router._draining.is_set():
@@ -428,8 +429,9 @@ class FleetRouter:
             host, port = self.address
             logger.info(
                 "fleet router on http://%s:%d (POST /predict, POST "
-                "/screen, POST /admin/rollover, GET /healthz, GET "
-                "/stats, GET /metrics; SIGHUP = rollover)", host, port)
+                "/screen, POST /assembly, POST /admin/rollover, GET "
+                "/healthz, GET /stats, GET /metrics; SIGHUP = rollover)",
+                host, port)
             while not guard.requested:
                 time.sleep(poll_seconds)
             logger.warning("drain requested (%s): stopping router and "

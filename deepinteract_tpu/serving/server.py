@@ -181,7 +181,8 @@ class ServingServer:
                  screen_max_pairs: int = 512,
                  default_deadline_ms: float = 0.0,
                  shedder_cfg: Optional[ShedderConfig] = None,
-                 index_path: Optional[str] = None):
+                 index_path: Optional[str] = None,
+                 calibration_path: Optional[str] = None):
         self.engine = engine
         self.latency = _LatencyTracker()
         self._draining = threading.Event()
@@ -212,6 +213,19 @@ class ServingServer:
         self._index_lock = threading.Lock()
         if index_path:
             self._get_index(index_path)
+        # Fitted probability calibration (deepinteract_tpu.calibration),
+        # verified at startup against the served weights — a worker with
+        # a stale or corrupt map fails HERE, not by silently rescaling
+        # its first response. Applied to /screen and /assembly rankings
+        # (raw scores always preserved alongside).
+        self.calibration_path = calibration_path
+        self.calibrator = None
+        if calibration_path:
+            from deepinteract_tpu.calibration import load_calibration
+
+            self.calibrator = load_calibration(
+                calibration_path,
+                expect_signature=engine.weights_signature())
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -281,8 +295,8 @@ class ServingServer:
                 # path — unknown client paths must not mint unbounded
                 # label values in the registry.
                 endpoint = self._route() if self._route() in (
-                    "/predict", "/screen", "/healthz", "/stats",
-                    "/metrics") else "other"
+                    "/predict", "/screen", "/assembly", "/healthz",
+                    "/stats", "/metrics") else "other"
                 _REQUESTS.inc(endpoint=endpoint, status=str(code))
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
@@ -337,7 +351,7 @@ class ServingServer:
 
             def do_POST(self):  # noqa: N802 - stdlib name
                 route = self._route()
-                if route not in ("/predict", "/screen"):
+                if route not in ("/predict", "/screen", "/assembly"):
                     self._send_json(404, {"error": f"no route {self.path}"})
                     return
                 if server._draining.is_set():
@@ -356,6 +370,9 @@ class ServingServer:
                     return
                 if route == "/screen":
                     self._do_screen()
+                    return
+                if route == "/assembly":
+                    self._do_assembly()
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -455,6 +472,45 @@ class ServingServer:
                     out["trace"] = trace
                 self._send_json(200, out)
 
+            def _do_assembly(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length).decode())
+                    if not isinstance(payload, dict):
+                        raise ValueError(
+                            "assembly body must be a JSON object")
+                    deadline = self._request_deadline(payload)
+                except Exception as exc:  # noqa: BLE001 - client error
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                reqtrace = RequestTrace("/assembly")
+                t0 = time.monotonic()
+                try:
+                    out = server.run_assembly(payload,
+                                              trace_id=reqtrace.trace_id,
+                                              deadline=deadline)
+                except DeadlineExceeded as exc:
+                    self._send_json(504, {"error": str(exc),
+                                          "trace_id": reqtrace.trace_id})
+                    return
+                except (ValueError, KeyError, FileNotFoundError,
+                        OSError) as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                except Exception as exc:  # noqa: BLE001 - surfaced
+                    logger.exception("assembly failed")
+                    self._send_json(500, {"error": str(exc)})
+                    return
+                out["latency_ms"] = (time.monotonic() - t0) * 1e3
+                out["trace_id"] = reqtrace.trace_id
+                encode_s = out.get("encode_seconds", 0.0)
+                decode_s = out.get("decode_seconds", 0.0)
+                reqtrace.set_phase("device", encode_s + decode_s)
+                trace = reqtrace.finish(encode=encode_s, decode=decode_s)
+                if self._trace_requested():
+                    out["trace"] = trace
+                self._send_json(200, out)
+
         self.httpd = _QuietThreadingHTTPServer((host, port), Handler)
         self._serve_thread: Optional[threading.Thread] = None
 
@@ -499,8 +555,8 @@ class ServingServer:
             self.serve_background()
             host, port = self.address
             logger.info("serving on http://%s:%d (POST /predict, "
-                        "POST /screen, GET /healthz, GET /stats, "
-                        "GET /metrics)", host, port)
+                        "POST /screen, POST /assembly, GET /healthz, "
+                        "GET /stats, GET /metrics)", host, port)
             while not guard.requested:
                 time.sleep(poll_seconds)
             logger.warning("drain requested (%s): refusing new requests, "
@@ -561,12 +617,78 @@ class ServingServer:
                     encode_batch=self.engine.cfg.max_batch))
             result = runner.screen(library, pairs, trace_id=trace_id,
                                    deadline=deadline)
-        return {
+        out = {
             "chains": result.chains,
             "pairs": result.pairs_total,
             "ranked": result.records,
             **result.summary(),
         }
+        if self.calibrator is not None:
+            from deepinteract_tpu.calibration.calibrator import (
+                annotate_records,
+            )
+
+            annotate_records(out["ranked"], self.calibrator)
+            out["calibration"] = self.calibration_path
+        return out
+
+    def run_assembly(self, payload: Dict, trace_id: str = "",
+                     deadline: Optional[Deadline] = None) -> Dict:
+        """Synchronous k-chain assembly for ``POST /assembly``
+        (deepinteract_tpu.assembly). Rides the same admission as
+        /screen: C(k,2) pairs count against ``screen_max_pairs``, the
+        shared embedding cache + screen lock serialize device work, and
+        the request deadline is enforced at batch boundaries. Raises
+        ValueError/KeyError/OSError for client mistakes (-> 400),
+        DeadlineExceeded -> 504."""
+        from deepinteract_tpu.assembly import AssemblyConfig, AssemblyRunner
+        from deepinteract_tpu.screening import ChainLibrary, EmbeddingCache
+
+        npz_paths = payload.get("npz_paths")
+        if not npz_paths or not isinstance(npz_paths, list):
+            raise ValueError("assembly body needs 'npz_paths': a "
+                             "non-empty list of complex .npz paths")
+        library = ChainLibrary.from_complex_files(
+            [str(p) for p in npz_paths])
+        chain_ids = payload.get("chains")
+        if chain_ids is not None and not isinstance(chain_ids, list):
+            raise ValueError("'chains' must be a list of chain ids")
+        k = len(chain_ids) if chain_ids else len(library.ids())
+        pairs = k * (k - 1) // 2
+        if pairs > self.screen_max_pairs:
+            raise ValueError(
+                f"assembly of {k} chains is {pairs} pairs, over the "
+                f"synchronous limit ({self.screen_max_pairs}); run "
+                "cli/assemble.py for large assemblies")
+        keep_maps = bool(payload.get("maps", False))
+        with self._screen_lock:
+            if self._screen_cache is None:
+                self._screen_cache = EmbeddingCache()
+            runner = AssemblyRunner(
+                self.engine, cache=self._screen_cache,
+                cfg=AssemblyConfig(
+                    top_k=int(payload.get("top_k", 10)),
+                    decode_batch=self.engine.cfg.max_batch,
+                    encode_batch=self.engine.cfg.max_batch,
+                    edge_threshold=float(
+                        payload.get("edge_threshold", 0.5)),
+                    control=bool(payload.get("control", True)),
+                    keep_maps=keep_maps),
+                calibrator=self.calibrator)
+            result = runner.assemble(library, chain_ids=chain_ids,
+                                     trace_id=trace_id,
+                                     deadline=deadline)
+        out = {
+            "ranked": result.records,
+            "interface": result.interface,
+            "weights_signature": self.engine.weights_signature(),
+            "calibration": self.calibration_path,
+            **result.summary(),
+        }
+        if keep_maps:
+            out["maps"] = {pid: np.asarray(m, dtype=np.float64).tolist()
+                           for pid, m in result.maps.items()}
+        return out
 
     def _get_index(self, path: str):
         """Open-or-cached ChainIndex handle; manifest problems surface
@@ -639,7 +761,7 @@ class ServingServer:
                 result = runner.query_from_index(
                     query, partitions=partitions, deadline=deadline,
                     on_deadline="partial")
-        return {
+        out = {
             "indexed": True,
             "index_path": index.index_dir,
             "query": result.query,
@@ -651,6 +773,14 @@ class ServingServer:
             "ranked": result.records,
             **result.summary(),
         }
+        if self.calibrator is not None:
+            from deepinteract_tpu.calibration.calibrator import (
+                annotate_records,
+            )
+
+            annotate_records(out["ranked"], self.calibrator)
+            out["calibration"] = self.calibration_path
+        return out
 
     # -- observability -----------------------------------------------------
 

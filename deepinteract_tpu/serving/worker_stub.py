@@ -86,8 +86,8 @@ class StubWorker:
 
                 body = json.dumps(payload).encode()
                 _REQUESTS.inc(endpoint=endpoint_label(
-                    self.path, ("/predict", "/screen", "/healthz",
-                                "/stats", "/metrics")),
+                    self.path, ("/predict", "/screen", "/assembly",
+                                "/healthz", "/stats", "/metrics")),
                     status=str(code))
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -116,7 +116,7 @@ class StubWorker:
                 route = self.path.partition("?")[0]
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                if route not in ("/predict", "/screen"):
+                if route not in ("/predict", "/screen", "/assembly"):
                     self._send_json(404, {"error": f"no route {route}"})
                     return
                 # Claim the in-flight slot BEFORE the draining check:
@@ -146,6 +146,10 @@ class StubWorker:
                     time.sleep(worker.delay_s)
                     if route == "/screen" and b'"index_path"' in body:
                         code, out = worker.indexed_screen(body)
+                        self._send_json(code, out)
+                        return
+                    if route == "/assembly":
+                        code, out = worker.assembly(body)
                         self._send_json(code, out)
                         return
                     self._send_json(200, {
@@ -224,6 +228,59 @@ class StubWorker:
             "pairs_decoded": len(survivors),
             "partial": False,
             "ranked": survivors,
+            "worker_id": self.worker_id,
+            "weights_signature": self.weights_signature,
+        }
+
+    def assembly(self, body: bytes):
+        """Deterministic fake of the real server's ``POST /assembly``
+        (k-chain complex scoring): takes the request's ``chains`` list
+        verbatim (no file IO, no numpy), scores each i<j pair as
+        ``crc32(pair_id) % 10^4 / 10^4``, and answers with the real
+        route's shape — ranked records, interface graph, encode-once
+        accounting (unique_encodes == k) — so the router's proxying of
+        /assembly is testable against real fleet processes in the fast
+        tier. Two stubs answer identically for the same chains."""
+        import zlib
+
+        try:
+            payload = json.loads(body.decode())
+        except ValueError as exc:
+            return 400, {"error": f"stub assembly: {exc}"}
+        ids = payload.get("chains") or ["stubA", "stubB"]
+        if not isinstance(ids, list) or len(ids) < 2:
+            return 400, {"error": "stub assembly: 'chains' must list "
+                                  ">= 2 chain ids"}
+        ids = [str(c) for c in ids]
+        threshold = float(payload.get("edge_threshold", 0.5))
+        ranked, edges = [], []
+        for i in range(len(ids)):
+            for j in range(i + 1, len(ids)):
+                c1, c2 = sorted((ids[i], ids[j]))
+                pid = f"{c1}|{c2}"
+                score = (zlib.crc32(pid.encode()) % 10_000) / 10_000
+                ranked.append({"pair_id": pid, "chain1": c1, "chain2": c2,
+                               "score": score, "max_prob": score,
+                               "top_k": 0, "top_contacts": []})
+                if score >= threshold:
+                    edges.append({"chain1": c1, "chain2": c2,
+                                  "pair_id": pid, "score": score})
+        ranked.sort(key=lambda r: (-r["score"], r["pair_id"]))
+        return 200, {
+            "ranked": ranked,
+            "interface": {"nodes": ids, "edges": edges},
+            "chains": len(ids),
+            "pairs_total": len(ranked),
+            "pairs_scored": len(ranked),
+            "unique_encodes": len(ids),
+            "encode_cache_hits": 0,
+            "decode_batches": 1,
+            "interface_edges": len(edges),
+            "interactability": (sum(r["score"] for r in ranked)
+                                / max(1, len(ranked))),
+            "control_score": None,
+            "calibrated": False,
+            "calibration": None,
             "worker_id": self.worker_id,
             "weights_signature": self.weights_signature,
         }

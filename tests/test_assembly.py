@@ -1,0 +1,460 @@
+"""Assembly + calibration subsystem tests (ISSUE-19 tentpole).
+
+Covers the two new packages end-to-end on the tiny model: calibration
+numerics (temperature recovery, ECE improvement, artifact round-trip
+with stale/corrupt refusal), AssemblyRunner parity with ScreenRunner
+(per-pair records byte-identical — the cross-subsystem agreement
+contract), encode-once accounting asserted through the ``di_assembly_*``
+counters, the synchronous ``POST /assembly`` route on a real
+ServingServer (including deadline 504, malformed 400, and the
+``screen_max_pairs`` admission cut), and fsck's census/quarantine of
+calibration artifacts and assembly bundles.
+
+Module-scoped engine (one split-phase compile bill for the file); the
+HTTP server fixture rides the same engine, mirroring tests/test_serving.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.assembly import (
+    AssemblyConfig,
+    AssemblyResult,
+    AssemblyRunner,
+)
+from deepinteract_tpu.assembly import runner as assembly_runner
+from deepinteract_tpu.calibration import (
+    Calibrator,
+    expected_calibration_error,
+    load_calibration,
+    miscalibrated_labels,
+    save_calibration,
+)
+from deepinteract_tpu.calibration.calibrator import (
+    fit_calibrator,
+    fit_temperature,
+)
+from deepinteract_tpu.data.io import save_complex_npz
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import ModelConfig
+from deepinteract_tpu.robustness.artifacts import (
+    CorruptArtifact,
+    StaleArtifact,
+)
+from deepinteract_tpu.robustness.preemption import PreemptionGuard
+from deepinteract_tpu.screening import (
+    ChainLibrary,
+    EmbeddingCache,
+    ScreenConfig,
+    ScreenRunner,
+)
+from deepinteract_tpu.serving import (
+    EngineConfig,
+    InferenceEngine,
+    ServingServer,
+)
+
+from tests.test_data_layer import make_raw_complex
+
+KNN, GEO = 6, 2
+
+
+def tiny_model_cfg():
+    return ModelConfig(
+        gnn=GTConfig(num_layers=1, hidden=16, num_heads=2, shared_embed=8,
+                     dropout_rate=0.0),
+        decoder=DecoderConfig(num_chunks=1, num_channels=8,
+                              dilation_cycle=(1,)),
+    )
+
+
+def all_pairs(ids):
+    return [(ids[i], ids[j])
+            for i in range(len(ids)) for j in range(i + 1, len(ids))]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InferenceEngine(
+        tiny_model_cfg(),
+        cfg=EngineConfig(max_batch=8, result_cache_size=16))
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def library():
+    # 6 chains = 15 pairs: enough to exercise multi-bucket grouping and
+    # padding without leaving the fast tier.
+    return ChainLibrary.synthetic(6, 20, 40, seed=3, knn=KNN,
+                                  geo_nbrhd_size=GEO)
+
+
+# ---------------------------------------------------------------------------
+# calibration numerics (no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_temperature_fit_recovers_truth_and_ece_improves():
+    """The held-out contract the CLI reports: labels drawn at an exact
+    miscalibration temperature are recovered by the fit, and BOTH
+    methods shrink ECE on the split the fit never saw."""
+    rng = np.random.default_rng(0)
+    probs = rng.beta(2.0, 5.0, size=4000)
+    labels = miscalibrated_labels(probs, true_temperature=2.5, seed=1)
+    fit_p, fit_y = probs[::2], labels[::2]
+    ev_p, ev_y = probs[1::2], labels[1::2]
+
+    t = fit_temperature(fit_p, fit_y)
+    assert 1.8 < t < 3.4  # ~2.5 up to sampling noise
+
+    ece_raw = expected_calibration_error(ev_p, ev_y)
+    assert ece_raw > 0.02  # the fixture really is miscalibrated
+    for method in ("temperature", "isotonic"):
+        cal = fit_calibrator(fit_p, fit_y, method=method,
+                             weights_signature="sig")
+        ece_cal = expected_calibration_error(cal.apply(ev_p), ev_y)
+        assert ece_cal < ece_raw, (method, ece_raw, ece_cal)
+
+
+def test_calibrator_artifact_roundtrip_stale_and_corrupt(tmp_path):
+    path = str(tmp_path / "calibration.json")
+    cal = Calibrator(method="temperature", temperature=2.25,
+                     weights_signature="sigA")
+    save_calibration(path, cal)
+
+    loaded = load_calibration(path, expect_signature="sigA")
+    assert loaded == cal
+    # Signature mismatch is a typed refusal; --allow_stale bypasses only
+    # the signature check, never integrity.
+    with pytest.raises(StaleArtifact):
+        load_calibration(path, expect_signature="sigB")
+    assert load_calibration(path, expect_signature="sigB",
+                            allow_stale=True) == cal
+
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(" ")  # byte-level tamper: sha256 sidecar must catch it
+    with pytest.raises(CorruptArtifact):
+        load_calibration(path, expect_signature="sigA", allow_stale=True)
+
+
+# ---------------------------------------------------------------------------
+# AssemblyRunner: parity, encode-once counters, interface graph
+# ---------------------------------------------------------------------------
+
+
+def test_assembly_records_byte_identical_to_screen(engine, library):
+    """Cross-subsystem agreement: an assembly's per-pair records must be
+    byte-identical to a bulk ScreenRunner screen of the same oriented
+    pairs — same scores, same 6-dp contacts, same canonical bucket
+    orientation."""
+    pairs = all_pairs(library.ids())
+    screen = ScreenRunner(engine, cache=EmbeddingCache(),
+                          cfg=ScreenConfig(top_k=10, decode_batch=8,
+                                           encode_batch=8))
+    screened = {r["pair_id"]: r
+                for r in screen.screen(library, pairs).records}
+
+    asm = AssemblyRunner(engine, cache=EmbeddingCache(),
+                         cfg=AssemblyConfig(control=False))
+    result = asm.assemble(library)
+    assert result.pairs_total == result.pairs_scored == len(pairs) == 15
+    assert len(result.records) == 15 and len(screened) == 15
+    for rec in result.records:
+        ref = screened[rec["pair_id"]]
+        for key in ("chain1", "chain2", "n1", "n2", "bucket",
+                    "score", "max_prob", "top_k", "top_contacts"):
+            assert rec[key] == ref[key], (rec["pair_id"], key)
+    # Ranked best-first with the shared deterministic tiebreak.
+    order = [(-r["score"], r["pair_id"]) for r in result.records]
+    assert order == sorted(order)
+    # Retained maps are the depadded [n1, n2] rectangles.
+    for rec in result.records:
+        assert result.maps[rec["pair_id"]].shape == (rec["n1"], rec["n2"])
+
+
+def test_assembly_encode_once_counters(engine, library):
+    """The encode-once contract, asserted through the di_assembly_*
+    counters: a cold assembly executes exactly k encoder passes for k
+    chains (regardless of C(k,2) pairs referencing them); a warm rerun
+    on the same cache executes zero and hits k times."""
+    cache = EmbeddingCache()
+    asm = AssemblyRunner(engine, cache=cache,
+                         cfg=AssemblyConfig(control=False,
+                                            keep_maps=False))
+    before = (assembly_runner._ENCODES.value(),
+              assembly_runner._ENCODE_HITS.value(),
+              assembly_runner._PAIRS.value(),
+              assembly_runner._RUNS.value())
+    cold = asm.assemble(library)
+    after = (assembly_runner._ENCODES.value(),
+             assembly_runner._ENCODE_HITS.value(),
+             assembly_runner._PAIRS.value(),
+             assembly_runner._RUNS.value())
+    assert cold.unique_encodes == cold.chains == 6
+    assert cold.encode_cache_hits == 0
+    assert after[0] - before[0] == 6   # encoder passes executed
+    assert after[1] - before[1] == 0
+    assert after[2] - before[2] == 15  # pairs decoded
+    assert after[3] - before[3] == 1
+
+    warm = asm.assemble(library)
+    assert warm.unique_encodes == 0
+    assert warm.encode_cache_hits == 6
+    assert assembly_runner._ENCODES.value() == after[0]
+    assert assembly_runner._ENCODE_HITS.value() - after[1] == 6
+    assert warm.maps == {}  # keep_maps=False drops the rectangles
+
+
+def test_assembly_interface_graph_control_and_calibration(engine, library):
+    """Interface graph thresholds on the EFFECTIVE (calibrated when
+    present) score, the control pass rides every record, and calibrated
+    fields sit NEXT TO raw ones (raw stays byte-identical to an
+    uncalibrated run)."""
+    raw_result = AssemblyRunner(
+        engine, cache=EmbeddingCache(),
+        cfg=AssemblyConfig(control=False)).assemble(library)
+
+    cal = Calibrator(method="temperature", temperature=2.0,
+                     weights_signature=engine.weights_signature())
+    result = AssemblyRunner(
+        engine, cache=EmbeddingCache(),
+        cfg=AssemblyConfig(edge_threshold=0.0),
+        calibrator=cal).assemble(library)
+
+    assert result.calibrated
+    raw_by_pid = {r["pair_id"]: r for r in raw_result.records}
+    from deepinteract_tpu.screening import pair_summary
+
+    for rec in result.records:
+        # Raw fields untouched by calibration.
+        assert rec["score"] == raw_by_pid[rec["pair_id"]]["score"]
+        # Calibrated summary == pair_summary over the calibrated map.
+        expect = pair_summary(cal.apply(result.maps[rec["pair_id"]]), 10)
+        assert rec["calibrated_score"] == expect["score"]
+        assert rec["calibrated_max_prob"] == expect["max_prob"]
+        for contact in rec["top_contacts"]:
+            assert contact["p_cal"] == round(
+                float(cal.apply(np.asarray(contact["p"]))), 6)
+        # input_indep control score rides along, in range.
+        assert 0.0 <= rec["control_score"] <= 1.0
+
+    assert result.control_score == pytest.approx(
+        np.mean([r["control_score"] for r in result.records]), abs=1e-6)
+    # threshold 0.0: every pair is an interface edge; interactability is
+    # the mean effective (calibrated) score.
+    assert len(result.interface["edges"]) == 15
+    assert result.interface["nodes"] == result.chain_ids
+    assert result.interactability == pytest.approx(
+        np.mean([r["calibrated_score"] for r in result.records]), abs=1e-9)
+    # Degenerate assemblies are refused, not half-scored.
+    with pytest.raises(ValueError):
+        AssemblyRunner(engine).assemble(library, chain_ids=["only-one"])
+    dup = library.ids()[0]
+    with pytest.raises(ValueError):
+        AssemblyRunner(engine).assemble(library, chain_ids=[dup, dup])
+
+
+# ---------------------------------------------------------------------------
+# POST /assembly on a real ServingServer
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def complex_paths(tmp_path_factory):
+    root = tmp_path_factory.mktemp("assembly_npz")
+    rng = np.random.default_rng(5)
+    paths = []
+    for i, (n1, n2) in enumerate([(20, 16), (24, 18), (22, 20)]):
+        raw = make_raw_complex(n1, n2, rng, knn=KNN)
+        path = str(root / f"cplx{i}.npz")
+        save_complex_npz(path, raw["graph1"], raw["graph2"],
+                         raw["examples"], f"cplx{i}")
+        paths.append(path)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def server(engine, tmp_path_factory):
+    cal_path = str(tmp_path_factory.mktemp("srv_cal") / "calibration.json")
+    save_calibration(cal_path, Calibrator(
+        method="temperature", temperature=2.0,
+        weights_signature=engine.weights_signature()))
+    srv = ServingServer(engine, port=0, calibration_path=cal_path)
+    guard = PreemptionGuard(log=lambda s: None)
+    thread = threading.Thread(target=lambda: srv.run(guard=guard),
+                              daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 5.0
+    while srv._serve_thread is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    yield srv, cal_path
+    guard.request("fixture teardown")
+    thread.join(timeout=15.0)
+
+
+def _post_assembly(srv, payload, headers=None):
+    import http.client
+
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=60.0)
+    try:
+        conn.request("POST", "/assembly", body=json.dumps(payload),
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def test_http_assembly_roundtrip_encode_once_and_calibrated(
+        server, complex_paths):
+    srv, cal_path = server
+    status, out = _post_assembly(srv, {
+        "npz_paths": complex_paths, "edge_threshold": 0.0,
+        "top_k": 5, "control": True})
+    assert status == 200, out
+    assert out["chains"] == 6 and out["pairs_total"] == 15
+    assert len(out["ranked"]) == 15
+    assert out["weights_signature"] == srv.engine.weights_signature()
+    assert out["calibration"] == cal_path and out["calibrated"]
+    assert out["trace_id"] and out["latency_ms"] >= 0.0
+    # Cold cache: exactly one encoder pass per unique chain.
+    assert out["unique_encodes"] == 6 and out["encode_cache_hits"] == 0
+    assert out["control_score"] is not None
+    for rec in out["ranked"]:
+        assert {"score", "calibrated_score",
+                "control_score"} <= set(rec)
+    assert len(out["interface"]["edges"]) == 15
+
+    # Same assembly again: the server's shared embedding cache serves
+    # every chain — zero encodes, k hits.
+    status, warm = _post_assembly(srv, {
+        "npz_paths": complex_paths, "edge_threshold": 0.0,
+        "top_k": 5, "control": True})
+    assert status == 200
+    assert warm["unique_encodes"] == 0
+    assert warm["encode_cache_hits"] == 6
+    assert [r["score"] for r in warm["ranked"]] == [
+        r["score"] for r in out["ranked"]]
+
+
+def test_http_assembly_client_errors_400(server, complex_paths):
+    srv, _ = server
+    status, out = _post_assembly(srv, {})
+    assert status == 400 and "npz_paths" in out["error"]
+    status, out = _post_assembly(
+        srv, {"npz_paths": complex_paths, "chains": "not-a-list"})
+    assert status == 400 and "chains" in out["error"]
+    status, out = _post_assembly(
+        srv, {"npz_paths": ["/nonexistent/complex.npz"]})
+    assert status == 400
+
+    # C(k,2) over the synchronous admission cut is refused up front.
+    old = srv.screen_max_pairs
+    srv.screen_max_pairs = 5
+    try:
+        status, out = _post_assembly(srv, {"npz_paths": complex_paths})
+        assert status == 400 and "limit" in out["error"]
+    finally:
+        srv.screen_max_pairs = old
+
+
+def test_http_assembly_deadline_504(server, complex_paths):
+    srv, _ = server
+    status, out = _post_assembly(
+        srv, {"npz_paths": complex_paths},
+        headers={"X-Request-Deadline-Ms": "0.01"})
+    assert status == 504
+    assert "deadline" in out["error"] and out["trace_id"]
+
+
+# ---------------------------------------------------------------------------
+# fsck: calibration census, stale-vs-fleet, torn bundle quarantine
+# ---------------------------------------------------------------------------
+
+
+def _tiny_bundle_result():
+    rec = {"pair_id": "a|b", "chain1": "a", "chain2": "b",
+           "n1": 2, "n2": 2, "bucket": [32, 32],
+           "score": 0.5, "max_prob": 0.6, "top_k": 1,
+           "top_contacts": [{"i": 0, "j": 0, "p": 0.6}]}
+    return AssemblyResult(
+        records=[rec], maps={"a|b": np.zeros((2, 2))},
+        chain_ids=["a", "b"], chains=2, pairs_total=1, pairs_scored=1,
+        unique_encodes=2, encode_cache_hits=0, encode_batches=1,
+        decode_batches=1, interface={"nodes": ["a", "b"], "edges": []},
+        interactability=0.5, control_score=None, calibrated=False,
+        encode_seconds=0.0, decode_seconds=0.0, emb_cache={})
+
+
+def _run_fsck(args, capsys):
+    from deepinteract_tpu.cli import fsck
+
+    rc = fsck.main(args)
+    lines = capsys.readouterr().out.strip().splitlines()
+    return rc, json.loads(lines[-1])
+
+
+def test_fsck_censuses_calibrations_and_flags_stale(tmp_path, capsys):
+    from deepinteract_tpu.cli.assemble import write_bundle
+
+    cal_path = str(tmp_path / "calibration.json")
+    save_calibration(cal_path, Calibrator(
+        method="temperature", temperature=2.0, weights_signature="sigA"))
+    write_bundle(str(tmp_path / "asm"), _tiny_bundle_result(), "sigA",
+                 cal_path)
+
+    rc, contract = _run_fsck([str(tmp_path)], capsys)
+    assert rc == 0 and contract["ok"]
+    assert contract["calibrations"] == 1
+    assert contract["assembly_bundles"] == 1
+    # No fleet census in the tree: nothing to be stale against.
+    assert contract["stale_calibrations"] == []
+
+    # A fleet census serving DIFFERENT weights makes the map promotion
+    # debt — same rule as stale index partitions.
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    (fleet_dir / "fleet_state.json").write_text(json.dumps({
+        "workers": {"w0": {"state": "healthy",
+                           "health": {"weights_signature": "sigB"}}}}))
+    rc, contract = _run_fsck([str(tmp_path)], capsys)
+    assert rc == 0
+    assert contract["stale_calibrations"] == [cal_path]
+    assert contract["calibrations"] == 1  # census unchanged
+
+
+def test_fsck_quarantines_torn_assembly_bundle(tmp_path, capsys):
+    from deepinteract_tpu.cli.assemble import write_bundle
+
+    ranked, bundle, maps = write_bundle(
+        str(tmp_path / "asm"), _tiny_bundle_result(), "sigA", None)
+    os.unlink(ranked)  # the bundle now references a deleted output
+
+    rc, contract = _run_fsck([str(tmp_path)], capsys)
+    assert rc == 1 and not contract["ok"]
+    assert bundle in contract["corrupt_paths"]
+    assert contract["assembly_bundles"] == 0
+
+    rc, contract = _run_fsck([str(tmp_path), "--quarantine"], capsys)
+    assert rc == 0 and contract["recovered"]
+    assert contract["quarantined"] == 1
+    assert not os.path.exists(bundle)
+
+    # A bit-flipped calibration artifact is integrity-corrupt too.
+    cal_path = str(tmp_path / "calibration.json")
+    save_calibration(cal_path, Calibrator(
+        method="temperature", temperature=2.0, weights_signature="sigA"))
+    with open(cal_path, "a", encoding="utf-8") as fh:
+        fh.write(" ")
+    rc, contract = _run_fsck([str(tmp_path)], capsys)
+    assert rc == 1 and cal_path in contract["corrupt_paths"]
+    assert contract["calibrations"] == 0

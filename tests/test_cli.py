@@ -54,6 +54,63 @@ def test_args_round_trip():
     assert loop_cfg.num_epochs == 3
 
 
+def test_predict_topk_with_calibration(dataset_root, tmp_path):
+    """--calibration adds calibrated probabilities NEXT TO the raw
+    columns (satellite of ISSUE-19): p_cal per contact and a
+    calibrated_score, while score/max_prob/p keep their raw meaning —
+    verified by independent recomputation through the same Calibrator.
+    Untrained predict (no checkpoint) keeps this inside the fast tier;
+    the artifact is keyed to the init-seed weights_signature."""
+    import json
+
+    from deepinteract_tpu.calibration import (
+        Calibrator,
+        load_calibration,
+        save_calibration,
+    )
+    from deepinteract_tpu.cli import predict as predict_cli
+
+    cal_path = str(tmp_path / "calibration.json")
+    cal = Calibrator(method="temperature", temperature=2.0,
+                     weights_signature="init-seed42")
+    save_calibration(cal_path, cal)
+
+    npz = str(dataset_root / "processed" / "ab" / "c2.npz")
+    out_dir = str(tmp_path / "pred_cal")
+    rc = predict_cli.main(
+        TINY_MODEL_ARGS
+        + ["--input_npz", npz, "--output_dir", out_dir,
+           "--top_k", "5", "--calibration", cal_path])
+    assert rc == 0
+
+    summary = json.load(open(os.path.join(out_dir, "top_contacts.json")))
+    assert summary["top_k"] == 5
+    assert summary["calibration"] == cal_path
+    loaded = load_calibration(cal_path,
+                              expect_signature="init-seed42")
+    ps = np.array([c["p"] for c in summary["top_contacts"]])
+    cal_ps = loaded.apply(ps)
+    for c, expect in zip(summary["top_contacts"], cal_ps):
+        assert c["p_cal"] == pytest.approx(float(expect), abs=1e-6)
+        # Raw probability column untouched by calibration.
+        assert 0.0 <= c["p"] <= 1.0
+    assert summary["calibrated_score"] == pytest.approx(
+        float(cal_ps.mean()), abs=1e-6)
+    # Raw score is still the uncalibrated top-k mean (the artifact's
+    # contacts carry 6-dp-rounded p's, hence the absolute tolerance).
+    assert summary["score"] == pytest.approx(float(ps.mean()), abs=2e-6)
+
+    # A mismatched weights_signature must refuse to load (stale).
+    from deepinteract_tpu.robustness.artifacts import StaleArtifact
+
+    with pytest.raises(StaleArtifact):
+        predict_cli.main(
+            TINY_MODEL_ARGS
+            + ["--input_npz", npz, "--output_dir", out_dir,
+               "--top_k", "5", "--calibration", cal_path,
+               "--seed", "7"])
+
+
 @pytest.mark.slow
 def test_train_then_test_then_predict(dataset_root, tmp_path):
     from deepinteract_tpu.cli import predict as predict_cli

@@ -73,8 +73,8 @@ def test_registered_kinds_cover_every_contract_cli():
     new entry point cannot silently ship without validator coverage."""
     assert {"bench", "screen", "tune", "predict_topk", "attribution",
             "perf_regression", "lint", "fsck", "fleet", "versions",
-            "train_supervise", "sustained", "index", "query"} <= set(
-                CONTRACTS)
+            "train_supervise", "sustained", "index", "query",
+            "assemble", "calibrate"} <= set(CONTRACTS)
     for kind, spec in CONTRACTS.items():
         assert set(spec["numeric"]) <= set(spec["required"]), kind
 
@@ -304,6 +304,106 @@ def test_index_and_query_kinds_match_real_cli_emission(tmp_path, capsys):
         rows = [json.loads(ln) for ln in fh]
     assert [r["rank"] for r in rows] == [1, 2, 3]
     assert rows[0]["partner"] == rec["top_partner"]["partner"]
+
+
+def test_calibrate_and_assemble_kinds_match_real_cli_emission(
+        tmp_path, capsys):
+    """The calibrate/v1 and assemble/v1 contracts are validated against
+    the REAL CLI lifecycle on a tiny synthetic library: fit a
+    temperature map on deterministic miscalibrated labels, then score
+    the same complex through the assembly runner WITH that calibration
+    applied — each capture's final line through its registered kind."""
+    from deepinteract_tpu.cli.assemble import main as assemble_main
+    from deepinteract_tpu.cli.calibrate import main as calibrate_main
+
+    cal_path = str(tmp_path / "calibration.json")
+    rc = calibrate_main([*TINY_MODEL_ARGS,
+                         "--synthetic_chains", "6",
+                         "--synthetic_len", "20,40",
+                         "--screen_batch", "4",
+                         "--calibration_out", cal_path])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "calibrate")
+    assert rec["schema"] == "calibrate/v1" and rec["ok"]
+    assert rec["method"] == "temperature" and rec["temperature"] > 1.0
+    # The whole point: held-out ECE must SHRINK after the fit.
+    assert rec["improved"] is True
+    assert rec["ece_calibrated"] < rec["ece_raw"]
+    assert rec["pairs"] == 15
+
+    rc = assemble_main([*TINY_MODEL_ARGS,
+                        "--synthetic_chains", "6",
+                        "--synthetic_len", "20,40",
+                        "--screen_batch", "4",
+                        "--calibration", cal_path,
+                        "--edge_threshold", "0.001",
+                        "--out", str(tmp_path / "asm")])
+    assert rc == 0
+    rec = check_cli_contract_text(capsys.readouterr().out, "assemble")
+    assert rec["schema"] == "assemble/v1" and rec["ok"]
+    assert rec["chains"] == 6 and rec["pairs_total"] == 15
+    assert rec["pairs_scored"] == 15
+    # Encode-once: exactly one encoder pass per unique chain.
+    assert rec["unique_encodes"] == 6
+    assert rec["calibrated"] is True and rec["calibration"] == cal_path
+    assert rec["control_score"] is not None
+    with open(rec["ranked_out"]) as fh:
+        rows = [json.loads(ln) for ln in fh]
+    assert len(rows) == 15 and rows[0]["rank"] == 1
+    assert "calibrated_score" in rows[0] and "score" in rows[0]
+
+
+def test_bench_headline_carries_assembly_keys():
+    """The bench assembly section's gated keys ride the contract line
+    (tools/check_perf_regression.py gates assembly.pairs_per_sec and
+    the encode-once ceiling assembly.unique_encodes <= assembly.chains)."""
+    import bench
+
+    line = bench._build_headline(
+        {"buckets": {"b1_p128": {"train_scan_complexes_per_sec": 33.0,
+                                 "batch": 1,
+                                 "train_scan_ms_per_step": 30.0}},
+         "assembly": {"pairs_per_sec": 5.1, "unique_encodes": 6,
+                      "chains": 6, "pairs": 15, "decode_batches": 4,
+                      "interface_edges": 15, "encode_seconds": 1.2,
+                      "note": "not a contract key"},
+         "interaction_stem": "factorized", "compute_dtype": "float32"},
+        scan_k=8)
+    assert line["assembly"]["pairs_per_sec"] == 5.1
+    assert line["assembly"]["unique_encodes"] == 6
+    assert line["assembly"]["chains"] == 6
+    assert "encode_seconds" not in line["assembly"]
+    assert "note" not in line["assembly"]
+    rec = check_cli_contract_text(json.dumps(line), "bench")
+    assert rec["value"] == 33.0
+
+
+def test_perf_gate_enforces_assembly_encode_once_ceiling():
+    """assembly.unique_encodes gates as a dynamic absolute ceiling: the
+    contract's own assembly.chains is the bar, so k encodes pass, k+1
+    regress — even against a zero-encode (cache-warm) baseline."""
+    from tools.check_perf_regression import compare
+
+    base = {"metric": "m", "unit": "u",
+            "assembly": {"pairs_per_sec": 5.0, "unique_encodes": 0,
+                         "chains": 6}}
+    ok = {"metric": "m", "unit": "u",
+          "assembly": {"pairs_per_sec": 5.0, "unique_encodes": 6,
+                       "chains": 6}}
+    assert compare(ok, base)["ok"] is True
+    bad = {"metric": "m", "unit": "u",
+           "assembly": {"pairs_per_sec": 5.0, "unique_encodes": 7,
+                        "chains": 6}}
+    verdict = compare(bad, base)
+    assert verdict["ok"] is False
+    assert any(r["key"] == "assembly.unique_encodes"
+               for r in verdict["regressions"])
+    # Nonzero baseline: ANY growth in encodes is a regression (tol 0).
+    base_nz = {"metric": "m", "unit": "u",
+               "assembly": {"pairs_per_sec": 5.0, "unique_encodes": 6,
+                            "chains": 6}}
+    assert compare(bad, base_nz)["ok"] is False
+    assert compare(ok, base_nz)["ok"] is True
 
 
 def test_bench_headline_carries_input_pipeline_keys():

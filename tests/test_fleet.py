@@ -448,6 +448,38 @@ def test_router_metrics_aggregation_per_worker_labels(tmp_path):
         router.drain()
 
 
+def test_router_proxies_assembly(tmp_path):
+    """POST /assembly rides the same fleet routing as /predict and
+    /screen: the router proxies it to a routable worker (the stub
+    answers with the real route's shape — ranked pairs, interface
+    graph, encode-once accounting) and the response is deterministic
+    across workers, so retries/failover cannot change an assembly."""
+    sup, router = make_fleet(tmp_path, n=2)
+    try:
+        host, port = router.address
+        body = json.dumps({"chains": ["a", "b", "c"],
+                           "edge_threshold": 0.0}).encode()
+        status, raw, headers = post(host, port, "/assembly", body)
+        assert status == 200 and "X-DI-Worker" in headers
+        payload = json.loads(raw)
+        assert payload["chains"] == 3 and payload["pairs_total"] == 3
+        assert payload["unique_encodes"] == 3  # encode-once accounting
+        assert payload["weights_signature"] == "v1"
+        assert len(payload["ranked"]) == 3
+        assert len(payload["interface"]["edges"]) == 3  # threshold 0.0
+        # Deterministic across the fleet: a second proxy (possibly onto
+        # the sibling worker) answers identically.
+        status2, raw2, _ = post(host, port, "/assembly", body)
+        assert status2 == 200
+        assert json.loads(raw2)["ranked"] == payload["ranked"]
+        # Malformed assembly bodies surface the worker's 400 verbatim.
+        status3, raw3, _ = post(host, port, "/assembly",
+                                json.dumps({"chains": ["solo"]}).encode())
+        assert status3 == 400
+    finally:
+        router.drain()
+
+
 def test_exposition_relabel_helpers():
     assert (_inject_label('di_x{a="b"} 1', "w1")
             == 'di_x{worker="w1",a="b"} 1')

@@ -100,6 +100,9 @@ CONTRACTS = {
     # index_partitions + stale_index_partitions are the ISSUE-17
     # additions: proteome-index partition census, and manifests frozen
     # at a weights_signature no healthy fleet worker serves.
+    # calibrations + stale_calibrations + assembly_bundles are the
+    # ISSUE-19 additions: fitted calibration census, calibrations frozen
+    # at an unserved weights_signature, and verified assembly bundles.
     "fsck": {
         "required": ("schema", "metric", "value", "unit", "ok", "root",
                      "scanned", "verified", "unverified", "corrupt",
@@ -107,10 +110,12 @@ CONTRACTS = {
                      "stale_heartbeats", "stale_heartbeat_hosts",
                      "resume_cursor", "fleet_versions",
                      "stale_version_ledgers", "index_partitions",
-                     "stale_index_partitions"),
+                     "stale_index_partitions", "calibrations",
+                     "stale_calibrations", "assembly_bundles"),
         "numeric": ("value", "scanned", "verified", "unverified",
                     "corrupt", "quarantined", "tmp_files",
-                    "stale_heartbeats", "index_partitions"),
+                    "stale_heartbeats", "index_partitions",
+                    "calibrations", "assembly_bundles"),
     },
     # sustained/v1: tools/sustained_train.py — end-to-end sustained
     # training rate, the device-resident scanned micro-bench it is
@@ -149,6 +154,35 @@ CONTRACTS = {
         "numeric": ("value", "chains", "candidates", "top_m",
                     "survivors", "pairs_decoded", "decode_batches",
                     "prefilter_survivor_frac", "elapsed_s"),
+    },
+    # assemble/v1: python -m deepinteract_tpu.cli.assemble (k-chain
+    # complex scoring: C(k,2) pairs, encode-once, interface graph,
+    # calibrated + control scores; deepinteract_tpu/assembly).
+    "assemble": {
+        "required": ("schema", "metric", "value", "unit", "ok", "chains",
+                     "pairs_total", "pairs_scored", "unique_encodes",
+                     "encode_cache_hits", "decode_batches",
+                     "interface_edges", "interactability",
+                     "control_score", "calibrated", "calibration",
+                     "weights_signature", "ranked_out", "bundle_out",
+                     "elapsed_s"),
+        "numeric": ("value", "chains", "pairs_total", "pairs_scored",
+                    "unique_encodes", "encode_cache_hits",
+                    "decode_batches", "interface_edges",
+                    "interactability", "elapsed_s"),
+    },
+    # calibrate/v1: python -m deepinteract_tpu.cli.calibrate (held-out
+    # temperature/isotonic fit with before/after ECE;
+    # deepinteract_tpu/calibration).
+    "calibrate": {
+        "required": ("schema", "metric", "value", "unit", "ok", "method",
+                     "temperature", "pairs", "contacts_fit",
+                     "contacts_eval", "ece_raw", "ece_calibrated",
+                     "improved", "weights_signature", "calibration_out",
+                     "elapsed_s"),
+        "numeric": ("value", "temperature", "pairs", "contacts_fit",
+                    "contacts_eval", "ece_raw", "ece_calibrated",
+                    "elapsed_s"),
     },
     # train_supervise/v1: cli/train.py --supervise (training/
     # supervisor.py TrainingSupervisor.contract): supervised restarts,

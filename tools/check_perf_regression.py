@@ -117,6 +117,16 @@ TOLERANCES = {
     # itself; the absolute prefetch-on scanned rate rides along.
     "input_pipeline.prefetch_overlap_ratio": (0.25, +1),
     "input_pipeline.scan_prefetch_cps": (0.35, +1),
+    # Assembly contract (bench `assembly` section, ISSUE-19): k-chain
+    # complex scoring throughput (C(k,2) pairs through the encode-once
+    # + micro-batched-decode path), and the encode-once invariant
+    # itself — unique_encodes must never exceed the chain count k (see
+    # ZERO_BASELINE_CEILINGS/DYNAMIC_CEILINGS: the measurement names its
+    # own bar via assembly.chains), because any growth means a pair
+    # re-encoded a chain and the O(k) encode economy silently became
+    # O(k^2).
+    "assembly.pairs_per_sec": (0.35, +1),
+    "assembly.unique_encodes": (0.0, -1),
     # Sustained-training contract (tools/sustained_train.py sustained/v1,
     # ISSUE-15): sustained/micro-bench-scan ratio, the ROADMAP item 4
     # >=0.70 bar. Dormant until a blessed baseline carries the key (the
@@ -135,6 +145,11 @@ ZERO_BASELINE_CEILINGS = {
     # re-executed work under that cadence (2.0 is the section default;
     # see DYNAMIC_CEILINGS for the contract-driven override).
     "recovery.steps_reexecuted": 2.0,
+    # Encode-once invariant: even against a 0-encode baseline (fully
+    # cache-warm round), a fresh run must not exceed one encode per
+    # chain (6.0 = the bench section's default k; see DYNAMIC_CEILINGS —
+    # the contract's own assembly.chains overrides).
+    "assembly.unique_encodes": 6.0,
 }
 # Ceilings whose true bound rides the contract itself: key -> the
 # contract key holding it. The bench recovery cadence is operator-
@@ -143,6 +158,7 @@ ZERO_BASELINE_CEILINGS = {
 # mask real ones at cadence 1) — the measurement names its own bar.
 DYNAMIC_CEILINGS = {
     "recovery.steps_reexecuted": "recovery.save_every_steps",
+    "assembly.unique_encodes": "assembly.chains",
 }
 # Keys whose values must match exactly for the runs to be comparable at
 # all (a different metric/unit is a different experiment, not a drift).
