@@ -106,6 +106,9 @@ SECTION_EST_S = {
     "assembly": 240,
     "input_pipeline": 420,
     "saturation": 240,
+    # Two mesh engines + two single engines (compact model): the p512
+    # tiled compiles dominate the CPU-rehearsal wall time.
+    "mesh_serving": 420,
     "rollover": 180,
     "elasticity": 200,
     "recovery": 240,
@@ -588,8 +591,8 @@ def _section_names(platform: str) -> list:
     # training now lands in the driver artifact, not only its forward.
     names = ["b1_p128", "stem_ab", "precision_ab", "b8_p128_bf16",
              "b1_p256", "b1_p384_tiled", "eval_path", "screening",
-             "assembly", "saturation", "rollover", "elasticity",
-             "recovery", "attribution", "input_pipeline"]
+             "assembly", "saturation", "mesh_serving", "rollover",
+             "elasticity", "recovery", "attribution", "input_pipeline"]
     if os.environ.get("DI_TUNING_STORE"):
         # Tuned-vs-default A/B row (right after the headline bucket so a
         # budget-truncated run still lands it): only when an operator
@@ -1476,6 +1479,154 @@ def _run_saturation_section(ctx, detail) -> None:
     _dump_partial(detail)
 
 
+def _run_mesh_serving_section(ctx, detail) -> None:
+    """Mesh-sharded serving (ISSUE-20): the same engine serving (a) mixed
+    small-bucket traffic data-parallel over a mesh vs one chip, and (b) a
+    single huge p512 complex with its interaction tensor row-sharded over
+    the pair axis vs decoded on one chip.
+
+    Protocol (CPU-rehearsable: the parent injects
+    ``--xla_force_host_platform_device_count=8``, so the mesh is 8
+    virtual CPU devices sharing ONE physical core — the mesh/1-chip
+    RATIOS are then rehearsal figures, honest about that in the note; on
+    real multi-chip hardware the same section measures the genuine
+    speedups):
+
+    1. closed-loop mixed traffic (two chain shapes, one bucket) against a
+       single-device engine, then against a data-axis mesh engine —
+       ``throughput_ratio`` = mesh served/sec over single served/sec;
+    2. one >256-residue complex (512-bucket, tiled decode) predicted on a
+       single device, then on a pair-axis mesh engine —
+       ``p512_latency_ms`` (pair-sharded) vs ``p512_single_latency_ms``.
+
+    Uses a COMPACT model config (not ctx['make_model']'s flagship): like
+    the rollover section's stub fleet, this section pins the serving-mesh
+    LAYER — placement routing, sharded AOT entries, halo-exchanged
+    decode — not the architecture's absolute speed."""
+    import threading as _threading
+
+    import jax
+
+    from deepinteract_tpu.models.decoder import DecoderConfig
+    from deepinteract_tpu.models.geometric_transformer import GTConfig
+    from deepinteract_tpu.models.model import ModelConfig
+    from deepinteract_tpu.screening import ChainLibrary
+    from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+    from deepinteract_tpu.serving.fleet import mesh_label
+
+    dc = jax.device_count()
+    if dc < 2:
+        raise RuntimeError(
+            f"mesh_serving needs >=2 devices, have {dc}: set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 for the CPU "
+            "rehearsal or run on real multi-chip hardware")
+    data_shape = (min(4, dc), 1)
+    pair_shape = (1, min(4, dc))
+    requests = int(os.environ.get("DI_BENCH_MESH_REQUESTS", "24"))
+    repeats = int(os.environ.get("DI_BENCH_MESH_REPEATS", "3"))
+    max_batch = 4
+    compact = ModelConfig(
+        gnn=GTConfig(num_layers=2, hidden=16, num_heads=2, shared_embed=8,
+                     dropout_rate=0.0),
+        decoder=DecoderConfig(num_chunks=1, num_channels=8,
+                              dilation_cycle=(1,)),
+    )
+    entry = {"devices": dc, "model": "compact",
+             "mesh_shape_data": mesh_label(data_shape),
+             "mesh_shape_pair": mesh_label(pair_shape),
+             "requests": requests, "max_batch": max_batch}
+    detail["mesh_serving"] = entry
+
+    # Mixed small-bucket traffic: two shapes from one bucket so the
+    # closed loop exercises coalescing, not bucket churn.
+    library = ChainLibrary.synthetic(4, 40, 60, seed=13)
+    ids = list(library.ids())
+    raws = [{"graph1": library[ids[i]].raw,
+             "graph2": library[ids[(i + 1) % len(ids)]].raw,
+             "examples": np.zeros((0, 3), np.int32)}
+            for i in range(len(ids))]
+
+    def _throughput(mesh_shape) -> float:
+        engine = InferenceEngine(
+            compact,
+            cfg=EngineConfig(max_batch=max_batch, max_delay_ms=2.0,
+                             result_cache_size=0, mesh_shape=mesh_shape))
+        try:
+            engine.warmup([(64, 64, s) for s in (1, 2, 4)])
+            counter = {"i": 0}
+            lock = _threading.Lock()
+
+            def worker(n):
+                for _ in range(n):
+                    with lock:
+                        raw = raws[counter["i"] % len(raws)]
+                        counter["i"] += 1
+                    engine.predict(raw)
+
+            per_worker = max(1, requests // max_batch)
+            threads = [_threading.Thread(target=worker, args=(per_worker,))
+                       for _ in range(max_batch)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return (per_worker * max_batch) / (time.perf_counter() - t0)
+        finally:
+            engine.close()
+
+    single_rps = _throughput(None)
+    entry["single_served_per_sec"] = round(single_rps, 3)
+    _dump_partial(detail)
+    mesh_rps = _throughput(data_shape)
+    entry["mesh_served_per_sec"] = round(mesh_rps, 3)
+    entry["throughput_ratio"] = round(mesh_rps / max(single_rps, 1e-9), 3)
+    _dump_partial(detail)
+
+    # One huge complex: both chains past the top bucket, so the decode
+    # runs tiled at the 512 bucket — the regime the pair axis exists for.
+    big = ChainLibrary.synthetic(2, 300, 340, seed=17)
+    bids = list(big.ids())
+    big_raw = {"graph1": big[bids[0]].raw, "graph2": big[bids[1]].raw,
+               "examples": np.zeros((0, 3), np.int32)}
+
+    def _p512_latency(mesh_shape) -> float:
+        engine = InferenceEngine(
+            compact,
+            cfg=EngineConfig(max_batch=1, mesh_shape=mesh_shape,
+                             pair_shard_threshold=512,
+                             result_cache_size=0))
+        try:
+            engine.predict(big_raw)  # compile + warm
+            samples = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                engine.predict(big_raw)
+                samples.append(time.perf_counter() - t0)
+            samples.sort()
+            return samples[len(samples) // 2]
+        finally:
+            engine.close()
+
+    single_lat = _p512_latency(None)
+    entry["p512_single_latency_ms"] = round(single_lat * 1e3, 2)
+    _dump_partial(detail)
+    pair_lat = _p512_latency(pair_shape)
+    entry["p512_latency_ms"] = round(pair_lat * 1e3, 2)
+    entry["p512_speedup"] = round(single_lat / max(pair_lat, 1e-9), 3)
+    entry["note"] = (
+        "compact-model serving-mesh rehearsal; on a shared-core virtual "
+        "CPU mesh the ratios carry coordination overhead with no extra "
+        "FLOPs, so >1.0 throughput_ratio / p512 speedup is only expected "
+        "on real multi-chip hardware")
+    _log(json.dumps({"mesh_serving": {
+        k: entry.get(k) for k in (
+            "throughput_ratio", "single_served_per_sec",
+            "mesh_served_per_sec", "p512_latency_ms",
+            "p512_single_latency_ms", "p512_speedup", "devices")}}))
+    _dump_partial(detail)
+
+
 def _run_rollover_section(ctx, detail) -> None:
     """Latency disruption of a LIVE warm rollover (ISSUE-13): steady
     closed-loop load through the fleet router while ``POST
@@ -2219,6 +2370,8 @@ def _run_section(name: str, ctx, detail) -> None:
         _run_assembly_section(ctx, detail)
     elif name == "saturation":
         _run_saturation_section(ctx, detail)
+    elif name == "mesh_serving":
+        _run_mesh_serving_section(ctx, detail)
     elif name == "rollover":
         _run_rollover_section(ctx, detail)
     elif name == "elasticity":
@@ -2420,6 +2573,19 @@ def _build_headline(detail, scan_k) -> dict:
                 for k in ("indexed_pairs_per_sec", "query_p50_ms",
                           "prefilter_survivor_frac", "chains", "top_m")
                 if k in idx}
+    mesh_serving = detail.get("mesh_serving", {})
+    if "throughput_ratio" in mesh_serving:
+        # Mesh-sharded serving contract keys (ISSUE-20): data-parallel
+        # mixed-traffic throughput vs one chip and the pair-sharded p512
+        # single-complex latency vs one chip. throughput_ratio and
+        # p512_latency_ms are gated in tools/check_perf_regression.py.
+        line["mesh_serving"] = {
+            k: mesh_serving[k]
+            for k in ("throughput_ratio", "single_served_per_sec",
+                      "mesh_served_per_sec", "p512_latency_ms",
+                      "p512_single_latency_ms", "p512_speedup",
+                      "mesh_shape_data", "mesh_shape_pair", "devices")
+            if k in mesh_serving}
     assembly = detail.get("assembly", {})
     if "pairs_per_sec" in assembly:
         # Assembly contract keys (ISSUE-19): k-chain complex scoring
@@ -2448,8 +2614,8 @@ def _is_partial(detail) -> bool:
     candidates += [v for k, v in detail.items()
                    if k.startswith(("attention_ab", "eval_path", "tuned_ab",
                                     "stem_ab", "precision_ab", "screening",
-                                    "assembly", "saturation", "rollover",
-                                    "elasticity", "recovery",
+                                    "assembly", "saturation", "mesh_serving",
+                                    "rollover", "elasticity", "recovery",
                                     "attribution", "input_pipeline"))
                    and isinstance(v, dict)]
     return any(("skipped" in c or "error" in c) for c in candidates
@@ -2502,6 +2668,14 @@ def _run_sections_isolated(names, detail, scan_k) -> None:
                    # Lets the child skip optional sub-measurements (the
                    # inline A/B halves) that cannot finish before the kill.
                    DI_BENCH_CHILD_DEADLINE=str(time.time() + timeout_s))
+        if name == "mesh_serving":
+            # The mesh section needs devices to shard over; on a CPU-only
+            # host give the child 8 virtual devices (the flag is inert on
+            # the TPU backend — real chips win).
+            xla = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in xla:
+                env["XLA_FLAGS"] = (
+                    xla + " --xla_force_host_platform_device_count=8").strip()
         err = None
         try:
             proc = subprocess.run(

@@ -277,6 +277,16 @@ def add_serving_args(p: argparse.ArgumentParser) -> None:
                    help="comma list of B1xB2xBATCH shapes compiled at "
                         "startup (e.g. 128x128x1,128x128x8) so first "
                         "requests hit warm executables")
+    g.add_argument("--mesh_shape", type=str, default="",
+                   help="serving mesh as DATAxPAIR device counts over "
+                        "this worker's slice (e.g. 4x1 shards batch "
+                        "slots over 4 chips; 1x4 row-shards one huge "
+                        "complex); empty = single-device")
+    g.add_argument("--pair_shard_threshold", type=int, default=512,
+                   help="bucket pad at/above which a mesh with a pair "
+                        "axis decodes row-sharded instead of data-"
+                        "replicated (placement policy; the router uses "
+                        "it for topology-aware bucket affinity too)")
     g.add_argument("--result_cache_size", type=int, default=256,
                    help="LRU entries of depadded contact maps keyed on a "
                         "content hash of the featurized complex (0 "

@@ -74,7 +74,9 @@ def parse_warmup_spec(spec: str) -> Tuple[Tuple[int, int, int], ...]:
 
 def warm_bucket_prefixes(spec: str, max_batch: int = 8,
                          pad_to_max_bucket: bool = False,
-                         diagonal_buckets: bool = False) -> Tuple[str, ...]:
+                         diagonal_buckets: bool = False,
+                         mesh_shape: Optional[Tuple[int, int]] = None,
+                         pair_shard_threshold: int = 512) -> Tuple[str, ...]:
     """Warmup specs -> the compile-inventory label prefixes a rollover
     replacement must report warm.
 
@@ -87,15 +89,31 @@ def warm_bucket_prefixes(spec: str, max_batch: int = 8,
     rollover contract promises away. Only the per-graph signature tail
     (``k20g2...``) is left open. Over-top-bucket specs additionally
     tile-lift inside the engine and may not match — a loud rollover
-    abort, never a silent cold switch."""
-    from deepinteract_tpu.data.loader import make_bucket_fn
-    from deepinteract_tpu.serving.fleet import batch_slots
+    abort, never a silent cold switch.
 
+    ``mesh_shape`` mirrors the engine's topology labeling: a meshed
+    worker prefixes every label with ``mesh{D}x{P}/`` and lifts
+    data-placement batch slots to the data-axis size, so the readiness
+    prefixes must too — otherwise a mesh rollover would wait on labels
+    the replacement can never report and abort every warm switch."""
+    from deepinteract_tpu.data.loader import make_bucket_fn
+    from deepinteract_tpu.serving.fleet import (
+        batch_slots,
+        mesh_label_prefix,
+        mesh_placement,
+        parse_mesh_shape,
+    )
+
+    shape = parse_mesh_shape(mesh_shape)
+    prefix = mesh_label_prefix(shape)
     bucket_fn = make_bucket_fn(pad_to_max_bucket, diagonal_buckets)
     out = []
     for b1, b2, bs in parse_warmup_spec(spec):
         nb1, nb2 = bucket_fn(b1, b2)
-        out.append(f"{nb1}x{nb2}/b{batch_slots(bs, max_batch)}/")
+        placement = mesh_placement(shape, nb1, nb2, pair_shard_threshold)
+        lift = shape[0] if placement == "data" else 1
+        out.append(
+            f"{prefix}{nb1}x{nb2}/b{batch_slots(bs, max_batch, lift_to=lift)}/")
     return tuple(out)
 
 
@@ -117,7 +135,7 @@ def engine_worker_cmd_fn(argv: List[str]):
                 "--port", str(port), "--heartbeat_file", heartbeat_path,
                 "--parent_pid", str(os.getpid())]
         for key in ("ckpt_name", "ckpt_dir", "compute_dtype",
-                    "warmup_buckets"):
+                    "warmup_buckets", "mesh_shape"):
             if overrides.get(key):
                 cmd += [f"--{key}", str(overrides[key])]
         return cmd
@@ -133,6 +151,8 @@ def _fleet_main(args, argv: List[str], guard=None) -> int:
     from deepinteract_tpu.serving.fleet import (
         FleetConfig,
         WorkerSupervisor,
+        mesh_label,
+        parse_mesh_shape,
         stub_worker_cmd,
     )
     from deepinteract_tpu.serving.router import FleetRouter, RouterConfig
@@ -140,10 +160,13 @@ def _fleet_main(args, argv: List[str], guard=None) -> int:
     state_dir = args.fleet_dir or tempfile.mkdtemp(prefix="di_fleet_")
     cmd_fn = (stub_worker_cmd if args.fleet_stub_workers
               else engine_worker_cmd_fn(argv))
+    mesh_shape = parse_mesh_shape(args.mesh_shape)
     required_warm = warm_bucket_prefixes(
         args.warmup_buckets, max_batch=args.max_batch,
         pad_to_max_bucket=args.pad_to_max_bucket,
-        diagonal_buckets=args.diagonal_buckets)
+        diagonal_buckets=args.diagonal_buckets,
+        mesh_shape=mesh_shape,
+        pair_shard_threshold=args.pair_shard_threshold)
     base_overrides = {}
     if args.fleet_stub_workers and required_warm:
         # Stubs must REPORT the operator's warmup buckets warm, or the
@@ -151,6 +174,10 @@ def _fleet_main(args, argv: List[str], guard=None) -> int:
         # --warmup_buckets) would wait out the warm timeout and abort
         # every rehearsal rollover on a non-default spec.
         base_overrides["warm_buckets"] = ",".join(required_warm)
+    if args.fleet_stub_workers and mesh_shape != (1, 1):
+        # Stubs advertise the fleet's topology so topology-aware routing
+        # and the rollover mesh-shape proof are rehearsable without jax.
+        base_overrides["mesh_shape"] = mesh_label(mesh_shape)
     supervisor = WorkerSupervisor(
         cmd_fn,
         overrides=base_overrides,
@@ -169,6 +196,10 @@ def _fleet_main(args, argv: List[str], guard=None) -> int:
             proxy_timeout_s=args.request_timeout_s,
             default_deadline_ms=args.default_deadline_ms,
             required_warm_buckets=required_warm,
+            required_mesh_shape=(mesh_label(mesh_shape)
+                                 if mesh_shape != (1, 1) else None),
+            pair_bucket_threshold=(args.pair_shard_threshold
+                                   if mesh_shape[1] > 1 else 0),
             warm_timeout_s=args.fleet_warm_timeout_s,
         ))
     router.start()
@@ -268,6 +299,7 @@ def main(argv=None, guard=None) -> int:
 
     from deepinteract_tpu.obs import spans as obs_spans
     from deepinteract_tpu.serving import EngineConfig, InferenceEngine, ServingServer
+    from deepinteract_tpu.serving.fleet import parse_mesh_shape
     from deepinteract_tpu.tuning.compile_cache import (
         enable_compile_cache,
         resolve_cache_dir,
@@ -324,6 +356,9 @@ def main(argv=None, guard=None) -> int:
         max_queue_depth=args.max_queue_depth,
         max_inflight=args.max_inflight,
         tuning_store=tuning_store,
+        mesh_shape=(parse_mesh_shape(args.mesh_shape)
+                    if args.mesh_shape else None),
+        pair_shard_threshold=args.pair_shard_threshold,
         # Explicitly typed --interaction_stem / --compute_dtype survive
         # tuned-entry adoption (tuning/consume.respect_explicit).
         pin_interaction_stem=pins["stem"],

@@ -99,18 +99,38 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def shard_pair_rows(x):
-    """with_sharding_constraint over the mesh's 'pair' axis on the row
-    dim of a [B, L1, ...] pair-map tensor (requires an active mesh). The
-    ONE place the pair-axis PartitionSpec is spelled out — model.py and
-    tiled.py annotate through this helper too. The batch dim stays
+def pair_row_spec():
+    """The row-dim PartitionSpec of a [B, L1, ...] pair-map tensor over
+    the mesh's 'pair' axis — the ONE place it is spelled out. Everything
+    that places or constrains pair rows (:func:`shard_pair_rows`,
+    :func:`pair_row_sharding` for the serving engine's AOT
+    ``in_shardings``) derives from here, so interior constraints and
+    entry placements can never disagree. The batch dim stays
     unconstrained (its data-axis sharding flows from the inputs; pinning
     it would break batch-1 init traces)."""
     from jax.sharding import PartitionSpec as P
 
     from deepinteract_tpu.parallel.mesh import PAIR_AXIS
 
-    return jax.lax.with_sharding_constraint(x, P(None, PAIR_AXIS))
+    return P(None, PAIR_AXIS)
+
+
+def pair_row_sharding(mesh):
+    """:func:`pair_row_spec` as a concrete ``NamedSharding`` — what the
+    serving engine bakes into a pair-placement executable's
+    ``in_shardings`` so per-chain factors arrive row-sharded instead of
+    being resharded on entry."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, pair_row_spec())
+
+
+def shard_pair_rows(x):
+    """with_sharding_constraint over the mesh's 'pair' axis on the row
+    dim of a [B, L1, ...] pair-map tensor (requires an active mesh). The
+    spec comes from :func:`pair_row_spec`; model.py and tiled.py
+    annotate through this helper too."""
+    return jax.lax.with_sharding_constraint(x, pair_row_spec())
 
 
 class PairStem1x1(nn.Module):

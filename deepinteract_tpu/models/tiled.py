@@ -114,4 +114,12 @@ def tiled_decode(
     # [n1*n2, B, T, T, K] -> [B, L1, L2, K]
     k = tiles.shape[-1]
     tiles = tiles.reshape(n1, n2, b, tile, tile, k)
-    return tiles.transpose(2, 0, 3, 1, 4, 5).reshape(b, l1, l2, k)
+    out = tiles.transpose(2, 0, 3, 1, 4, 5).reshape(b, l1, l2, k)
+    if shard_pair_axis:
+        from deepinteract_tpu.models.stem import shard_pair_rows
+
+        # Keep the assembled full map row-sharded too: without this the
+        # scatter-back gathers every tile onto one device before the
+        # caller's softmax/masking, defeating the per-shard decode.
+        out = shard_pair_rows(out)
+    return out

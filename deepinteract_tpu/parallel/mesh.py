@@ -55,6 +55,24 @@ def make_mesh(
     return Mesh(arr, (DATA_AXIS, PAIR_AXIS))
 
 
+def serving_mesh(shape: Sequence[int],
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """Build the (data, pair) mesh one serving worker owns from its
+    ``--mesh_shape`` ``(num_data, num_pair)`` pair — the same
+    :func:`make_mesh` layout training uses, so a worker's pair-sharded
+    decode partitions exactly like the training-time sharded step.
+    Validates both axes explicitly (a worker must fail LOUDLY at startup
+    on a topology its slice cannot provide, not at first decode)."""
+    if len(shape) != 2:
+        raise ValueError(f"serving mesh shape needs 2 axes, got {shape!r}")
+    num_data, num_pair = int(shape[0]), int(shape[1])
+    if num_data < 1 or num_pair < 1:
+        raise ValueError(
+            f"serving mesh axes must be >= 1, got {num_data}x{num_pair}")
+    return make_mesh(num_data=num_data, num_pair=num_pair,
+                     devices=devices)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """The per-step batch sharding ([B, ...] split over ``data``) — the
     ONE definition shared by batch placement (:func:`shard_batch`, the

@@ -58,7 +58,13 @@ from deepinteract_tpu.serving.admission import (
     expired_counter,
 )
 from deepinteract_tpu.serving.cache import ResultCache, content_hash
-from deepinteract_tpu.serving.fleet import batch_slots as fleet_batch_slots
+from deepinteract_tpu.serving.fleet import (
+    batch_slots as fleet_batch_slots,
+    mesh_label,
+    mesh_label_prefix,
+    mesh_placement,
+    parse_mesh_shape,
+)
 from deepinteract_tpu.serving.scheduler import MicroBatchScheduler
 
 logger = logging.getLogger(__name__)
@@ -132,6 +138,16 @@ class EngineConfig:
     # bucket must not degrade the others. The full tuned tuple is logged
     # either way.
     tuning_store: Optional[str] = None
+    # Serving mesh topology as (num_data, num_pair) device counts (the
+    # worker's slice; CLI surface ``--mesh_shape``). None/(1, 1) keeps
+    # the single-device AOT path byte-identical. With a mesh, batch
+    # slots shard over the data axis (throughput) and over-threshold
+    # buckets row-shard over the pair axis (single-complex latency) —
+    # see :meth:`InferenceEngine.placement_for`.
+    mesh_shape: Optional[Tuple[int, int]] = None
+    # Bucket pad at/above which a mesh with a pair axis decodes one
+    # complex row-sharded instead of replicating it per data shard.
+    pair_shard_threshold: int = 512
 
 
 class InferenceEngine:
@@ -157,6 +173,11 @@ class InferenceEngine:
 
         self.cfg = cfg
         base = model_cfg or ModelConfig()
+        # Mesh topology is fixed before tuned-config adoption: the
+        # tuning-store bucket key carries it, and a stored trial may
+        # override the per-bucket placement policy.
+        self._mesh_shape = parse_mesh_shape(cfg.mesh_shape)
+        self._placement_overrides: Dict[Tuple[int, int], str] = {}
         # Tuned-config adoption happens on the UN-tiled config (the
         # signature the tuner measured under); tiling is forced after.
         self.adopted_tuning = None
@@ -165,13 +186,36 @@ class InferenceEngine:
         if not base.tile_pair_map:
             base = dataclasses.replace(base, tile_pair_map=True)
         self.model = DeepInteract(base)
+        self._mesh = None
+        self._pair_model = None
+        if self._mesh_shape != (1, 1):
+            from deepinteract_tpu.parallel.mesh import serving_mesh
+
+            self._mesh = serving_mesh(self._mesh_shape)
+            if self._mesh_shape[1] > 1:
+                # Pair-placement sibling: SAME param tree (shard_pair_map
+                # only adds sharding constraints — models/stem.py keeps
+                # one tree for both stems), separate traced functions so
+                # the row-sharded decode gets its own AOT entries.
+                self._pair_model = DeepInteract(dataclasses.replace(
+                    base, shard_pair_map=True))
         self._tile = int(base.tile_size)
         self._base_bucket_fn = make_bucket_fn(
             cfg.pad_to_max_bucket, cfg.diagonal_buckets)
+        # Tuned placement overrides were recorded against raw warmup
+        # specs; re-key them onto the buckets the request path computes.
+        self._placement_overrides = {
+            self.bucket_for(*k): v
+            for k, v in self._placement_overrides.items()}
 
-        # Executable cache: (b1, b2, batch, knn, geo) -> AOT-compiled fn.
-        self._executables: Dict[Tuple[int, int, int, int, int], Any] = {}
+        # Executable cache: the bucket/signature/batch key PLUS the mesh
+        # topology and placement (appended by _compiled) -> AOT-compiled
+        # fn.
+        self._executables: Dict[Tuple, Any] = {}
         self._compile_seconds: Dict[str, float] = {}
+        # Per-entry provenance for /stats.compile_inventory: seconds +
+        # the topology/placement the entry compiled under.
+        self._compile_info: Dict[str, Dict[str, Any]] = {}
         self._exec_lock = threading.Lock()
         # Compile-inventory labels mirrored under their OWN tiny lock:
         # /healthz reads them every supervisor probe tick and must
@@ -193,12 +237,60 @@ class InferenceEngine:
         self.cache = ResultCache(cfg.result_cache_size)
         self._seed = int(seed)
         self._init_weights(seed, ckpt_dir, metric_to_track)
+        if self._mesh is not None:
+            from deepinteract_tpu.parallel.mesh import replicate
+
+            # Jitted init committed the weights to device 0; a
+            # mesh-compiled executable expects them replicated across
+            # its slice — committed arrays with a mismatched sharding
+            # would raise at the first warm call.
+            self.params = replicate(self.params, self._mesh)
+            self.batch_stats = replicate(self.batch_stats, self._mesh)
         self._jit_forward = jax.jit(self._forward)
         # Split-phase executables (bulk screening, deepinteract_tpu/
         # screening): one encoder pass per CHAIN, one decode per pair over
         # cached embeddings — registered in the same bucketed cache.
         self._jit_encode = jax.jit(self._encode)
         self._jit_decode = jax.jit(self._decode)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from deepinteract_tpu.parallel.mesh import batch_sharding
+
+            # Placement-specific jit handles, each baking its
+            # in_shardings (PR-15 constructors verbatim): "data" shards
+            # batch slots over the data axis, "repl" replicates a group
+            # whose slot count the data axis does not divide, "pair"
+            # broadcasts the per-chain factors and row-shards inside the
+            # decode (models/stem.py pair_row_spec constraints).
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            data = batch_sharding(self._mesh)
+            self._jit_forward_data = jax.jit(
+                self._forward, in_shardings=(repl, repl, data, data))
+            self._jit_forward_repl = jax.jit(
+                self._forward, in_shardings=(repl, repl, repl, repl))
+            self._jit_encode_data = jax.jit(
+                self._encode, in_shardings=(repl, repl, data))
+            self._jit_encode_repl = jax.jit(
+                self._encode, in_shardings=(repl, repl, repl))
+            self._jit_decode_data = jax.jit(
+                self._decode,
+                in_shardings=(repl, repl, data, data, data, data))
+            self._jit_decode_repl = jax.jit(
+                self._decode,
+                in_shardings=(repl, repl, repl, repl, repl, repl))
+            if self._pair_model is not None:
+                from deepinteract_tpu.models.stem import pair_row_sharding
+
+                rows = pair_row_sharding(self._mesh)
+                self._jit_forward_pair = jax.jit(
+                    self._forward_pair,
+                    in_shardings=(repl, repl, repl, repl))
+                # Chain-1 embeddings/masks arrive row-sharded (they ARE
+                # the sharded dim); chain-2 factors broadcast per-shard.
+                self._jit_decode_pair = jax.jit(
+                    self._decode_pair,
+                    in_shardings=(repl, repl, rows, repl, rows, repl))
         if cfg.warmup_buckets:
             self.warmup(cfg.warmup_buckets)
         self.admission = AdmissionController(
@@ -208,7 +300,11 @@ class InferenceEngine:
             self._flush, max_batch=cfg.max_batch,
             max_delay_ms=cfg.max_delay_ms,
             admission=self.admission,
-            on_expired=self._expired_in_queue)
+            on_expired=self._expired_in_queue,
+            # A data-axis-full group is already a complete mesh dispatch
+            # (slot lift pads to D regardless): flush it immediately
+            # instead of waiting out max_delay_ms for stragglers.
+            flush_quantum=self._mesh_shape[0])
 
     # -- autotuning --------------------------------------------------------
 
@@ -227,13 +323,23 @@ class InferenceEngine:
             b1 = b2 = constants.CHAIN_LENGTH_BUCKETS[-1]
             bs = 1
         pad = max(b1, b2)
-        adopted = consume.lookup_path(self.cfg.tuning_store, base, bs, pad)
+        # Derived from cfg, not self._mesh_shape: this helper's contract
+        # is cfg-only (test_tuning drives it on a bare shell).
+        mesh_shape = parse_mesh_shape(self.cfg.mesh_shape)
+        adopted = consume.lookup_path(self.cfg.tuning_store, base, bs, pad,
+                                      mesh_shape=mesh_shape)
         if adopted is None:
             logger.info(
                 "autotune: no tuning-store entry for bucket b%d_p%d in %s; "
                 "serving with default configs", bs, pad,
                 self.cfg.tuning_store)
             return base
+        if (adopted.config.mesh_placement in ("data", "pair")
+                and mesh_shape != (1, 1)):
+            # Per-bucket autotuner override of the placement policy
+            # (re-keyed through bucket_for once the bucket fn exists).
+            self._placement_overrides[(int(b1), int(b2))] = \
+                adopted.config.mesh_placement
         # The Pallas grid is a MODEL-wide setting but the entry was tuned
         # at one symmetric bucket: the kernel runs at each chain's OWN
         # pad, so the grid applies only when legal at every padded length
@@ -370,13 +476,50 @@ class InferenceEngine:
             return lift(b1), lift(b2)
         return b1, b2
 
-    def _batch_slots(self, n_requests: int) -> int:
+    def _batch_slots(self, n_requests: int,
+                     bucket: Optional[Tuple[int, int]] = None) -> int:
         """Coalesced groups pad to the next power of two (capped at
         max_batch) so the per-bucket executable inventory stays
         O(log max_batch) instead of one compile per observed group
         size. Delegates to the shared policy the fleet's rollover
-        readiness check also uses (serving/fleet.batch_slots)."""
-        return fleet_batch_slots(n_requests, self.cfg.max_batch)
+        readiness check also uses (serving/fleet.batch_slots).
+
+        On a data-parallel mesh the floor lifts to the data-axis size so
+        every chip holds at least one slot (pair-placement buckets skip
+        the lift: one huge complex row-shards instead of replicating)."""
+        lift = 1
+        if (self._mesh is not None and self._mesh_shape[0] > 1
+                and (bucket is None
+                     or self.placement_for(*bucket) != "pair")):
+            lift = self._mesh_shape[0]
+        return fleet_batch_slots(n_requests, self.cfg.max_batch,
+                                 lift_to=lift)
+
+    def placement_for(self, b1: int, b2: int) -> str:
+        """Mesh placement for one bucket: the shared policy
+        (serving/fleet.mesh_placement — small buckets replicate
+        data-parallel, over-threshold buckets pair-shard) unless the
+        adopted tuning entry pinned this bucket explicitly. Reads are
+        lock-free: the override map is frozen at construction."""
+        if self._mesh is None:
+            return "single"
+        placement = self._placement_overrides.get((int(b1), int(b2)))
+        if placement is None:
+            placement = mesh_placement(
+                self._mesh_shape, b1, b2, self.cfg.pair_shard_threshold)
+        if placement == "pair" and self._pair_model is None:
+            placement = "data"
+        return placement
+
+    def _effective_placement(self, b1: int, b2: int, slots: int) -> str:
+        """What actually compiles for one (bucket, slots) key: a "data"
+        group whose slot count the data axis does not divide degrades to
+        "repl" (replicated execution) — deterministic per key, since
+        slots is part of the key."""
+        placement = self.placement_for(b1, b2)
+        if placement == "data" and slots % self._mesh_shape[0] != 0:
+            placement = "repl"
+        return placement
 
     # -- compile cache -----------------------------------------------------
 
@@ -388,6 +531,21 @@ class InferenceEngine:
         import jax
 
         logits = self.model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            graph1, graph2, train=False,
+        )
+        return jax.nn.softmax(logits, axis=-1)[..., 1]
+
+    def _forward_pair(self, params, batch_stats, graph1, graph2):
+        # Pair-placement twin of _forward: same params, but the apply
+        # goes through the shard_pair_map sibling so the interaction
+        # map row-shards over the mesh's 'pair' axis (models/stem.py
+        # constraints; XLA inserts the halo exchange / gather at dilated
+        # conv boundaries). Separate traced fn => its own cache entries.
+        self.trace_count += 1  # di: allow[lock-discipline] traces run under _exec_lock via _compiled
+        import jax
+
+        logits = self._pair_model.apply(
             {"params": params, "batch_stats": batch_stats},
             graph1, graph2, train=False,
         )
@@ -424,6 +582,16 @@ class InferenceEngine:
             feats1, feats2, mask1, mask2, train=False, method="decode")
         return jax.nn.softmax(logits, axis=-1)[..., 1]
 
+    def _decode_pair(self, params, batch_stats, feats1, feats2, mask1,
+                     mask2):
+        self.trace_count += 1  # di: allow[lock-discipline] traces run under _exec_lock via _compiled
+        import jax
+
+        logits = self._pair_model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            feats1, feats2, mask1, mask2, train=False, method="decode")
+        return jax.nn.softmax(logits, axis=-1)[..., 1]
+
     def chain_bucket(self, n: int) -> int:
         """Padded bucket for a LONE chain under this engine's bucket
         policy (the split-phase analog of :meth:`bucket_for`)."""
@@ -433,25 +601,57 @@ class InferenceEngine:
                           graph_batch):
         """AOT-compiled per-chain-bucket encoder over a ``[slots, bucket,
         ...]`` stacked graph batch; cached under the same inventory as the
-        monolithic executables."""
+        monolithic executables. The encoder is per-chain (no pair map),
+        so mesh placement is data-axis only: slots shard when the data
+        axis divides them, else the batch replicates."""
+        placement = "single"
+        jit_fn = self._jit_encode
+        if self._mesh is not None:
+            if slots % self._mesh_shape[0] == 0:
+                placement, jit_fn = "data", self._jit_encode_data
+            else:
+                placement, jit_fn = "repl", self._jit_encode_repl
         key = ("enc", bucket, sig, slots)
         return self._compiled(
             key, f"enc:{bucket}/b{slots}/k{sig[0]}g{sig[1]}",
-            self._jit_encode, (self.params, self.batch_stats, graph_batch))
+            jit_fn, (self.params, self.batch_stats, graph_batch),
+            placement=placement)
 
     def decode_executable(self, b1: int, b2: int, slots: int, args: Tuple):
         """AOT-compiled per-(bucket1, bucket2, batch) interaction-stem +
         decoder over cached embeddings. ``args`` is (feats1, feats2,
-        mask1, mask2) at the padded bucket shapes."""
+        mask1, mask2) at the padded bucket shapes. Placement follows
+        :meth:`placement_for`: an over-threshold bucket on a pair-axis
+        mesh decodes row-sharded (this is the p512+ single-complex
+        path), everything else data-shards or replicates."""
+        placement = self._effective_placement(b1, b2, slots)
+        jit_fn = {
+            "single": self._jit_decode,
+            "data": getattr(self, "_jit_decode_data", None),
+            "repl": getattr(self, "_jit_decode_repl", None),
+            "pair": getattr(self, "_jit_decode_pair", None),
+        }[placement]
         key = ("dec", b1, b2, slots)
         return self._compiled(
-            key, f"dec:{b1}x{b2}/b{slots}", self._jit_decode,
-            (self.params, self.batch_stats) + tuple(args))
+            key, f"dec:{b1}x{b2}/b{slots}", jit_fn,
+            (self.params, self.batch_stats) + tuple(args),
+            placement=placement)
 
     def weights_signature(self) -> str:
         """Identity of the served weights — part of the embedding-cache
         key (an embedding is a function of chain features AND weights)."""
         return self.restored_from or f"init-seed{self._seed}"
+
+    @property
+    def mesh_shape(self) -> Tuple[int, int]:
+        """(num_data, num_pair) of the worker's serving mesh; (1, 1)
+        when serving single-device."""
+        return self._mesh_shape
+
+    def mesh_shape_label(self) -> str:
+        """Canonical ``"DxP"`` topology label — what /healthz advertises
+        for the router's topology-aware placement and warm proofs."""
+        return mesh_label(self._mesh_shape)
 
     def warm_bucket_labels(self) -> list:
         """Sorted compile-inventory labels (the ``compiled_buckets``
@@ -461,11 +661,28 @@ class InferenceEngine:
         with self._labels_lock:
             return list(self._warm_labels)
 
-    def _compiled(self, key: Tuple, label: str, jit_fn, args):
+    def _compiled(self, key: Tuple, label: str, jit_fn, args,
+                  placement: str = "single"):
         """Warm path: dict hit, zero traces. Cold path: one explicit
         lower+compile, recorded in the per-bucket inventory. Shared by the
         monolithic forward and the split-phase encode/decode executables
-        (one cache, one lock, one compile counter)."""
+        (one cache, one lock, one compile counter).
+
+        The mesh topology and placement ride EVERY key and the topology
+        prefixes every label (serving/fleet.mesh_label_prefix): a 1-chip
+        and a 4-chip entry for the same bucket can never collide in the
+        cache, and a replacement worker on a different topology can
+        never satisfy this worker's rollover warm proof. Single-device
+        engines keep their existing keys/labels verbatim. Mesh compiles
+        lower under mesh_context: the interior with_sharding_constraint
+        annotations resolve their bare PartitionSpecs against the
+        ambient mesh at trace time."""
+        key = key + (self._mesh_shape, placement)
+        label = mesh_label_prefix(self._mesh_shape) + label
+        if placement in ("pair", "repl"):
+            # Suffix (never a prefix: warm-readiness matches on label
+            # prefixes) so the inventory shows WHICH mesh path compiled.
+            label = f"{label}/{placement}"
         with self._exec_lock:
             cached = self._executables.get(key)
             if cached is not None:
@@ -473,22 +690,45 @@ class InferenceEngine:
             t0 = time.perf_counter()
             _COMPILE_INFLIGHT.inc()
             try:
-                compiled = jit_fn.lower(*args).compile()
+                if self._mesh is not None:
+                    from deepinteract_tpu.parallel.mesh import mesh_context
+
+                    with mesh_context(self._mesh):
+                        compiled = jit_fn.lower(*args).compile()
+                else:
+                    compiled = jit_fn.lower(*args).compile()
             finally:
                 _COMPILE_INFLIGHT.dec()
             self._executables[key] = compiled
             elapsed = time.perf_counter() - t0
             self._compile_seconds[label] = elapsed
+            self._compile_info[label] = {
+                "seconds": round(elapsed, 3),
+                "mesh_shape": mesh_label(self._mesh_shape),
+                "placement": placement,
+            }
             with self._labels_lock:
                 self._warm_labels = tuple(sorted(self._compile_seconds))
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(elapsed)
             return compiled
 
-    def _executable_for(self, key: Tuple[int, int, int, int, int], batch):
+    def _forward_executable(self, key: Tuple, batch, placement: str):
+        jit_fn = {
+            "single": self._jit_forward,
+            "data": getattr(self, "_jit_forward_data", None),
+            "repl": getattr(self, "_jit_forward_repl", None),
+            "pair": getattr(self, "_jit_forward_pair", None),
+        }[placement]
         return self._compiled(
-            key, self._key_label(key), self._jit_forward,
-            (self.params, self.batch_stats, batch.graph1, batch.graph2))
+            key, self._key_label(key), jit_fn,
+            (self.params, self.batch_stats, batch.graph1, batch.graph2),
+            placement=placement)
+
+    def _executable_for(self, key: Tuple[int, int, int, int, int], batch):
+        b1, b2, slots = key[0], key[1], key[-1]
+        return self._forward_executable(
+            key, batch, self._effective_placement(b1, b2, slots))
 
     @staticmethod
     def _key_label(key: Tuple) -> str:
@@ -507,7 +747,7 @@ class InferenceEngine:
         executable no request could look up — paying startup compile AND
         the first client's cold trace."""
         nb1, nb2 = self.bucket_for(b1, b2)
-        return nb1, nb2, self._batch_slots(bs)
+        return nb1, nb2, self._batch_slots(bs, bucket=(nb1, nb2))
 
     def warmup(self, buckets: Sequence[Tuple[int, int, int]],
                knn: int = constants.KNN,
@@ -667,7 +907,7 @@ class InferenceEngine:
                                   input_indep=self.cfg.input_indep)
                 for it in items
             ]
-            slots = self._batch_slots(len(complexes))
+            slots = self._batch_slots(len(complexes), bucket=(b1, b2))
             pad_slots = slots - len(complexes)
             complexes.extend([complexes[0]] * pad_slots)
             batch = stack_complexes(complexes)
@@ -760,12 +1000,15 @@ class InferenceEngine:
     def stats(self) -> Dict[str, Any]:
         with self._exec_lock:
             compiled = dict(self._compile_seconds)
+            inventory = {label: dict(info)
+                         for label, info in self._compile_info.items()}
             executed_batches = self._executed_batches
             executed_requests = self._executed_requests
             padded_slots = self._padded_slots
         return {
             "uptime_seconds": time.time() - self._started,
             "restored_from": self.restored_from,
+            "mesh_shape": self.mesh_shape_label(),
             # The served model's stem/precision configuration: what the
             # AOT executables were actually compiled with.
             "interaction_stem": self.model.cfg.interaction_stem,
@@ -780,6 +1023,11 @@ class InferenceEngine:
             },
             "trace_count": self.trace_count,
             "compiled_buckets": compiled,
+            # Topology-stamped inventory (satellite of the mesh-native
+            # engine): each entry records the mesh shape + placement it
+            # compiled under, so operators can SEE that 1-chip and mesh
+            # entries are distinct, not just trust the cache key.
+            "compile_inventory": inventory,
             "num_compiled_executables": len(compiled),
             "executed_batches": executed_batches,
             "executed_requests": executed_requests,

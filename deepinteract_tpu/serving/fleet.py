@@ -218,15 +218,98 @@ class QuietHTTPServer(ThreadingHTTPServer):
                      exc_info=True)
 
 
-def batch_slots(n_requests: int, max_batch: int) -> int:
+def batch_slots(n_requests: int, max_batch: int,
+                lift_to: int = 1) -> int:
     """Coalesced-group padding policy: next power of two, capped at
     ``max_batch``. ONE implementation shared by the engine's executable
     inventory (``InferenceEngine._batch_slots``) and the rollover
     readiness prefixes (``cli/serve.warm_bucket_prefixes``) — if these
     drifted, replacements would compile labels the router's warm check
-    no longer matches and every rollover would abort on timeout."""
+    no longer matches and every rollover would abort on timeout.
+
+    ``lift_to`` raises the floor (rounded up to a power of two): a
+    data-parallel mesh worker lifts slots to its data-axis size so every
+    chip holds at least one sample; the ``max_batch`` cap still wins —
+    an operator's batch ceiling outranks shard occupancy (the engine
+    then falls back to replicated execution for the indivisible group).
+    """
     slots = 1 << (max(1, int(n_requests)) - 1).bit_length()
-    return min(slots, max(1, int(max_batch)))
+    floor = 1 << (max(1, int(lift_to)) - 1).bit_length()
+    return min(max(slots, floor), max(1, int(max_batch)))
+
+
+def parse_mesh_shape(spec) -> "tuple[int, int]":
+    """``"DxP"`` (e.g. ``"4x1"``, ``"2x4"``) -> ``(data, pair)`` device
+    counts. Accepts an already-parsed 2-tuple/list verbatim and ``None``
+    / ``""`` as the single-device shape ``(1, 1)``. The ONE parser the
+    engine config, CLI plumbing, router placement, and stub health
+    payloads share, so a topology label can never mean two things."""
+    if spec is None or spec == "":
+        return (1, 1)
+    if isinstance(spec, (tuple, list)):
+        if len(spec) != 2:
+            raise ValueError(f"mesh shape needs 2 axes, got {spec!r}")
+        data, pair = int(spec[0]), int(spec[1])
+    else:
+        parts = str(spec).lower().split("x")
+        if len(parts) != 2:
+            raise ValueError(
+                f"mesh shape must look like 'DATAxPAIR' (e.g. '4x1'), "
+                f"got {spec!r}")
+        try:
+            data, pair = int(parts[0]), int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"mesh shape must be two integers 'DATAxPAIR', got "
+                f"{spec!r}") from None
+    if data < 1 or pair < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {data}x{pair}")
+    return (data, pair)
+
+
+def mesh_label(shape) -> str:
+    """Canonical ``"DxP"`` topology label for health payloads, compile
+    inventory, and the fleet contract (``(1, 1)``/None -> ``"1x1"``)."""
+    data, pair = parse_mesh_shape(shape)
+    return f"{data}x{pair}"
+
+
+def mesh_label_prefix(shape) -> str:
+    """Compile-label prefix carrying the topology: ``""`` for the
+    single-device shape (existing labels, warm prefixes, and rollover
+    specs stay valid verbatim), ``"mesh<D>x<P>/"`` otherwise. A PREFIX,
+    not a suffix, because the router's warm-readiness check is
+    ``label.startswith(required)`` — a 1-chip replacement can never
+    satisfy a mesh worker's warm proof, and vice versa."""
+    data, pair = parse_mesh_shape(shape)
+    if (data, pair) == (1, 1):
+        return ""
+    return f"mesh{data}x{pair}/"
+
+
+def mesh_placement(shape, bucket1: int, bucket2: int,
+                   pair_threshold: int) -> str:
+    """Placement policy for one bucket on one worker topology:
+
+    * ``"single"`` — no mesh (shape ``(1, 1)``): today's one-device AOT
+      entries, byte-identical behavior.
+    * ``"pair"`` — the mesh has a pair axis and the bucket's longer side
+      reaches ``pair_threshold``: one huge complex row-shards across
+      chips (latency scaling for p512+ antibody/spike-scale maps).
+    * ``"data"`` — everything else on a mesh: batch slots shard over the
+      data axis (throughput scaling for small-bucket traffic).
+
+    Pure and jax-free so the engine, ``cli/serve.warm_bucket_prefixes``,
+    and the router's topology-aware routing share ONE policy; the
+    autotuner may override it per bucket (``TrialConfig.mesh_placement``).
+    """
+    data, pair = parse_mesh_shape(shape)
+    if (data, pair) == (1, 1):
+        return "single"
+    if pair > 1 and pair_threshold > 0 and \
+            max(int(bucket1), int(bucket2)) >= pair_threshold:
+        return "pair"
+    return "data"
 
 
 def free_port(host: str = "127.0.0.1") -> int:
@@ -293,7 +376,8 @@ def stub_worker_cmd(worker_id: str, port: int, heartbeat_path: str,
     if sig:
         cmd += ["--weights_signature", str(sig)]
     for key in ("warm_buckets", "delay_ms", "warm_after_s",
-                "crash_after_s", "heartbeat_interval_s", "probs_value"):
+                "crash_after_s", "heartbeat_interval_s", "probs_value",
+                "mesh_shape"):
         if key in overrides:
             cmd += [f"--{key}", str(overrides[key])]
     return cmd

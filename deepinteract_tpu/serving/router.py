@@ -86,10 +86,36 @@ from deepinteract_tpu.serving.fleet import (
     WorkerSupervisor,
     endpoint_label,
     fan_out,
+    parse_mesh_shape,
     request_json,
 )
 
 logger = logging.getLogger(__name__)
+
+
+def _bucket_hint_dims(bucket_hint: Optional[str]) -> Optional[Tuple[int, int]]:
+    """Parse an ``X-DI-Bucket`` hint ("N1xN2") into its bucket dims;
+    None for absent/malformed hints — placement is best-effort, a bad
+    header must never fail routing."""
+    if not bucket_hint:
+        return None
+    parts = str(bucket_hint).lower().split("x")
+    if len(parts) != 2:
+        return None
+    try:
+        return int(parts[0]), int(parts[1])
+    except ValueError:
+        return None
+
+
+def _advertises_pair_axis(health: Optional[Dict[str, Any]]) -> bool:
+    """True when a worker's /healthz payload advertises a mesh with a
+    pair axis (mesh_shape "DxP", P > 1) — the workers huge-complex
+    requests prefer. Tolerant of pre-mesh workers (no field -> 1x1)."""
+    try:
+        return parse_mesh_shape((health or {}).get("mesh_shape"))[1] > 1
+    except ValueError:
+        return False
 
 _ROUTED = obs_metrics.counter(
     "di_fleet_routed_total", "Requests answered through the router",
@@ -160,6 +186,18 @@ class RouterConfig:
     # (e.g. ("128x128/",) from --warmup_buckets). Empty = status ok
     # (+ signature match) is warm enough.
     required_warm_buckets: Tuple[str, ...] = ()
+    # Mesh topology label ("DxP") a replacement must advertise in
+    # /healthz before a rollover may switch to it, and the fleet
+    # contract's topology record. None = any topology (single-device
+    # fleets, mixed rehearsals). With it set, warm_buckets prefixes are
+    # already topology-prefixed (serving/fleet.mesh_label_prefix), so
+    # the rollover warm proof is per-topology end to end.
+    required_mesh_shape: Optional[str] = None
+    # Bucket pad at/above which a request's X-DI-Bucket hint prefers
+    # workers advertising a pair-axis mesh (mesh_shape "Dx P" with
+    # P > 1): huge-complex requests route to pair-sharded workers
+    # first, with the rest of the fleet as the failover tail. 0 = off.
+    pair_bucket_threshold: int = 0
     # Bound on the replacement warm-up wait before a rollover aborts.
     warm_timeout_s: float = 300.0
     # SIGTERM-drain grace for the old workers after the routing swap.
@@ -475,10 +513,10 @@ class FleetRouter:
         weighted round-robin and order its workers first; other
         versions' workers stay as the failover tail, so an unpinned
         request is never dropped while ANY version is healthy."""
-        sig_of = {
-            w["worker_id"]:
-                str((w.get("health") or {}).get("weights_signature"))
-            for w in self.sup.routable_workers()}
+        health_of = {w["worker_id"]: (w.get("health") or {})
+                     for w in self.sup.routable_workers()}
+        sig_of = {wid: str(health.get("weights_signature"))
+                  for wid, health in health_of.items()}
         chosen: Optional[str] = None
         with self._lock:
             candidates = [wid for wid in self._active if wid in sig_of]
@@ -500,11 +538,35 @@ class FleetRouter:
                     sequence = (
                         [w for w in sequence if sig_of[w] == chosen]
                         + [w for w in sequence if sig_of[w] != chosen])
+            if self._wants_pair_worker(bucket_hint):
+                # Topology-aware placement LAST (it outranks the version
+                # ordering): a p512+ hint goes to pair-sharded workers
+                # first — a data-parallel worker would decode the huge
+                # map on one chip (models/tiled.py) at a latency the
+                # pair path exists to beat. Stable within each group;
+                # non-pair workers remain as the failover tail, so the
+                # request still completes on a degraded fleet.
+                pair_first = [w for w in sequence
+                              if _advertises_pair_axis(health_of.get(w))]
+                if pair_first:
+                    sequence = pair_first + [w for w in sequence
+                                             if w not in set(pair_first)]
         picked = version if version is not None else chosen
         if picked is not None:
             _VERSION_PICKS.inc(version=picked,
                                mode="pinned" if version else "weighted")
         return sequence
+
+    def _wants_pair_worker(self, bucket_hint: Optional[str]) -> bool:
+        """Placement trigger: the bucket hint's longer side reaches the
+        configured pair threshold — the same over-threshold rule the
+        engine's placement policy applies (serving/fleet.mesh_placement),
+        read from the request side."""
+        if self.cfg.pair_bucket_threshold <= 0:
+            return False
+        dims = _bucket_hint_dims(bucket_hint)
+        return (dims is not None
+                and max(dims) >= self.cfg.pair_bucket_threshold)
 
     def _choose_version_locked(self, available: set) -> Optional[str]:
         """Smooth weighted round-robin (the nginx algorithm) over the
@@ -901,6 +963,13 @@ class FleetRouter:
         if info["state"] != "healthy" or health.get("status") != "ok":
             return False
         if target_sig and health.get("weights_signature") != target_sig:
+            return False
+        if (self.cfg.required_mesh_shape
+                and str(health.get("mesh_shape") or "1x1")
+                != self.cfg.required_mesh_shape):
+            # Wrong topology can never be warm: its compile inventory
+            # belongs to a different device layout even if the label
+            # prefixes happened to match.
             return False
         warm = health.get("warm_buckets") or []
         return all(any(str(label).startswith(req) for label in warm)
@@ -1331,6 +1400,7 @@ class FleetRouter:
             "routed": routed,
             "preemptions": sup["preemptions"],
             "versions": versions,
+            "mesh_shape": self.cfg.required_mesh_shape or "1x1",
             "state_path": sup["state_path"],
         }
 

@@ -86,11 +86,17 @@ class MicroBatchScheduler:
         max_delay_ms: float = 5.0,
         admission: Optional[AdmissionController] = None,
         on_expired: Optional[Callable[[Any, Deadline], Exception]] = None,
+        flush_quantum: int = 1,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._flush_fn = flush_fn
         self.max_batch = int(max_batch)
+        # A group this size is already a "full" device dispatch even
+        # below max_batch — a mesh engine sets it to the data-axis
+        # device count, whose batch slots lift to that floor anyway, so
+        # waiting out max_delay_ms past it buys padding, not coalescing.
+        self.flush_quantum = max(1, min(int(flush_quantum), self.max_batch))
         self.max_delay_s = max(0.0, float(max_delay_ms)) / 1e3
         self.admission = admission
         self._on_expired = on_expired
@@ -210,6 +216,8 @@ class MicroBatchScheduler:
                     continue
                 deadline = q[0][2] + self.max_delay_s
                 if (len(q) >= self.max_batch or now >= deadline
+                        or (self.flush_quantum > 1
+                            and len(q) >= self.flush_quantum)
                         or self._closed):
                     # Oldest-deadline-first across READY buckets.
                     if ready_key is None or deadline < ready_deadline:

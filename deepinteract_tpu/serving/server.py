@@ -338,6 +338,8 @@ class ServingServer:
                         "degraded": degraded,
                         "weights_signature":
                             server.engine.weights_signature(),
+                        "mesh_shape":
+                            server.engine.mesh_shape_label(),
                         "warm_buckets":
                             server.engine.warm_bucket_labels(),
                     })

@@ -58,9 +58,15 @@ class StubWorker:
     def __init__(self, worker_id: str, weights_signature: str,
                  warm_buckets: List[str], delay_ms: float,
                  warm_after_s: float, host: str = "127.0.0.1",
-                 port: int = 0, probs_value: float = 0.5):
+                 port: int = 0, probs_value: float = 0.5,
+                 mesh_shape: str = "1x1"):
         self.worker_id = worker_id
         self.weights_signature = weights_signature
+        # Advertised topology label ("DxP"): a stub never owns devices,
+        # but the router's topology-aware placement and rollover warm
+        # proofs key on /healthz mesh_shape — this makes them
+        # stub-fleet-testable without jax.
+        self.mesh_shape = str(mesh_shape or "1x1")
         self.configured_buckets = list(warm_buckets)
         self.delay_s = max(0.0, float(delay_ms)) / 1e3
         # The single fake prediction value: two stubs with different
@@ -295,6 +301,7 @@ class StubWorker:
             "draining": self._draining.is_set(),
             "degraded": False,
             "weights_signature": self.weights_signature,
+            "mesh_shape": self.mesh_shape,
             "warm_buckets": list(self.configured_buckets) if warm else [],
             "worker_id": self.worker_id,
             # Queue-depth signal: the supervisor's probes cache this in
@@ -338,6 +345,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comma list of compile-inventory labels "
                              "healthz reports once warm")
     parser.add_argument("--delay_ms", type=float, default=10.0)
+    parser.add_argument("--mesh_shape", default="1x1",
+                        help="advertised mesh topology label 'DxP' "
+                             "(fake: rehearses topology-aware routing)")
     parser.add_argument("--probs_value", type=float, default=0.5,
                         help="the stub's constant contact probability — "
                              "distinct values make two versions disagree "
@@ -358,7 +368,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.worker_id, args.weights_signature,
         [b for b in args.warm_buckets.split(",") if b.strip()],
         args.delay_ms, args.warm_after_s, host=args.host, port=args.port,
-        probs_value=args.probs_value)
+        probs_value=args.probs_value, mesh_shape=args.mesh_shape)
     hb = None
     if args.heartbeat_file:
         hb = Heartbeat(args.heartbeat_file,

@@ -59,36 +59,49 @@ class Adopted:
             f"pallas_blocks=({c.pallas_fwd_blocks}, {c.pallas_bwd_blocks}), "
             f"diagonal_buckets={c.diagonal_buckets}, "
             f"stem={c.interaction_stem or 'kept-config'}, "
-            f"dtype={c.compute_dtype or 'kept-config'} "
+            f"dtype={c.compute_dtype or 'kept-config'}, "
+            f"mesh_placement={c.mesh_placement or 'policy'} "
             f"[{self.source}{', partial search' if self.partial else ''}]"
         )
 
 
 def lookup(store: Optional[TuningStore], model_cfg, batch: int, pad: int,
-           ) -> Optional[Adopted]:
+           mesh_shape=None) -> Optional[Adopted]:
     """Resolve the tuned config for ``(model_cfg, b{batch}_p{pad})`` on
     this process's device, with the any-bucket fallback. None = nothing
-    usable in the store."""
+    usable in the store.
+
+    ``mesh_shape`` (the serving worker's (data, pair) topology) tries
+    the topology-suffixed bucket key FIRST, then falls back to the plain
+    single-device key — mesh knobs that transfer (stem, scan_chunks)
+    still adopt on a mesh worker whose topology was never tuned, while a
+    topology-specific entry (e.g. a pinned ``mesh_placement``) wins when
+    one exists."""
     if store is None:
         return None
     sig = model_signature(model_cfg)
-    bucket = bucket_key(batch, pad)
-    key = runtime_key(sig, bucket)
-    entry = store.get(key)
-    if entry is not None and "config" in entry:
-        return Adopted(config=TrialConfig.from_dict(entry["config"]),
-                       key=key, source="exact",
-                       partial=bool(entry.get("partial")))
+    buckets = [bucket_key(batch, pad, mesh_shape=mesh_shape)]
+    plain = bucket_key(batch, pad)
+    if plain != buckets[0]:
+        buckets.append(plain)
+    for bucket in buckets:
+        key = runtime_key(sig, bucket)
+        entry = store.get(key)
+        if entry is not None and "config" in entry:
+            return Adopted(config=TrialConfig.from_dict(entry["config"]),
+                           key=key, source="exact",
+                           partial=bool(entry.get("partial")))
     entry = store.best_entry_any_bucket(sig)
     if entry is not None and "config" in entry:
         return Adopted(config=TrialConfig.from_dict(entry["config"]),
-                       key=key, source="bucket_fallback",
+                       key=runtime_key(sig, buckets[0]),
+                       source="bucket_fallback",
                        partial=bool(entry.get("partial")))
     return None
 
 
 def lookup_path(store_path: Optional[str], model_cfg, batch: int, pad: int,
-                ) -> Optional[Adopted]:
+                mesh_shape=None) -> Optional[Adopted]:
     """:func:`lookup` from a path, via the replicated (multi-host-safe)
     read. A missing store returns None; a schema-mismatched store raises
     (StoreSchemaError) — silently training on stale knobs is the failure
@@ -96,7 +109,7 @@ def lookup_path(store_path: Optional[str], model_cfg, batch: int, pad: int,
     if not store_path:
         return None
     store = TuningStore.load_replicated(store_path)
-    return lookup(store, model_cfg, batch, pad)
+    return lookup(store, model_cfg, batch, pad, mesh_shape=mesh_shape)
 
 
 def restrict_pallas_blocks(adopted: Optional[Adopted], pads,

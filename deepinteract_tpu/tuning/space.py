@@ -60,6 +60,13 @@ class TrialConfig:
     # --interaction_stem / --compute_dtype with a searched default.
     interaction_stem: Optional[str] = None
     compute_dtype: Optional[str] = None
+    # Serving-mesh placement for the bucket: a DECLARED axis like
+    # compute_dtype (TrialConfig + the engine's adoption honor it, and
+    # the store key can carry the mesh topology — see ``bucket_key``)
+    # that is not auto-searched: the single-process tuner has no mesh to
+    # measure under. None = the engine's placement policy
+    # (serving/fleet.mesh_placement); "data"/"pair" pin the bucket.
+    mesh_placement: Optional[str] = None
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -88,6 +95,8 @@ class TrialConfig:
             parts.append(f"stem-{self.interaction_stem}")
         if self.compute_dtype is not None:
             parts.append(self.compute_dtype)
+        if self.mesh_placement is not None:
+            parts.append(f"mesh-{self.mesh_placement}")
         return ",".join(parts)
 
 
@@ -293,5 +302,14 @@ def model_signature(model_cfg) -> str:
     )
 
 
-def bucket_key(batch: int, pad: int) -> str:
-    return f"b{batch}_p{pad}"
+def bucket_key(batch: int, pad: int, mesh_shape=None) -> str:
+    """Store-key bucket token. ``mesh_shape`` (a ``(data, pair)`` tuple;
+    None/(1, 1) = single-device) suffixes the key so entries tuned under
+    different serving topologies never alias — a placement/grid measured
+    on a 2x4 mesh says nothing about the 1-chip build of the same
+    bucket. Single-device keys are unchanged, so every existing store
+    resolves exactly as before."""
+    key = f"b{batch}_p{pad}"
+    if mesh_shape is not None and tuple(mesh_shape) != (1, 1):
+        key += f"_m{int(mesh_shape[0])}x{int(mesh_shape[1])}"
+    return key

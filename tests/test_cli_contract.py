@@ -205,6 +205,9 @@ def test_fleet_kind_matches_real_router_emission(tmp_path, capsys):
     # ISSUE-16 keys ride the same record: no preemption happened, and
     # the drained fleet serves zero live versions.
     assert rec["preemptions"] == 0 and rec["versions"] == 0
+    # ISSUE-20 topology key: no --mesh_shape means the single-device
+    # default.
+    assert rec["mesh_shape"] == "1x1"
 
 
 def test_versions_kind_matches_real_router_emission(tmp_path):

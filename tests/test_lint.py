@@ -92,7 +92,7 @@ def test_repo_wide_suppressions_are_intentional(capsys):
     main([])
     rec = json.loads(
         [ln for ln in capsys.readouterr().out.splitlines() if ln][-1])
-    # 24 = 10 pre-ISSUE-12 pragmas + 9 artifact-write waivers + the
+    # 26 = 10 pre-ISSUE-12 pragmas + 9 artifact-write waivers + the
     # ISSUE-15 loader-boundary waiver on the SWA params placement
     # (training/loop.py — a params tree, not a batch) + 4 ISSUE-16
     # lock-discipline waivers in the router's _choose_version_locked
@@ -106,8 +106,12 @@ def test_repo_wide_suppressions_are_intentional(capsys):
     # move) — every other write-mode open() was converted to robustness/
     # artifacts.atomic_write (train_supervisor_state.json does; the
     # train_supervise/v1 contract prints from cli/train.py, which the
-    # no-print rule exempts).
-    assert rec["suppressed"] <= 24, (
+    # no-print rule exempts). + 2 ISSUE-20 lock-discipline waivers on
+    # the mesh pair-placement traced twins (_forward_pair/_decode_pair
+    # in serving/engine.py): the trace_count increment runs once per
+    # TRACE inside _compiled's lower(), under _exec_lock — the exact
+    # waiver the seed's three traced fns already carry.
+    assert rec["suppressed"] <= 26, (
         "suppression count grew — justify or fix the new ones")
 
 

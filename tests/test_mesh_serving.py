@@ -1,0 +1,439 @@
+"""Mesh-sharded serving tests: placement policy, sharded-decode parity,
+zero-retrace warm paths, and topology-aware routing (serving/engine.py
+mesh mode + serving/router.py placement).
+
+tests/conftest.py forces ``--xla_force_host_platform_device_count=8``, so
+every engine here sees a virtual 8-CPU-device mesh — the parity suite
+proves the mesh decode agrees with the single-device engine without TPU
+hardware: bit-exact f32 on the data axis (sharding only moves slots),
+rounding-noise f32 on the pair axis (row sharding reorders the decoder's
+instance-norm reductions), tolerance under bf16. Engines are module-scoped where shared (mesh AOT compiles
+are the expensive part); the decoder/dtype variants are one-shot inside
+their own tests.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deepinteract_tpu.models.decoder import DecoderConfig
+from deepinteract_tpu.models.geometric_transformer import GTConfig
+from deepinteract_tpu.models.model import ModelConfig
+from deepinteract_tpu.models.vision import DeepLabConfig
+from deepinteract_tpu.serving import EngineConfig, InferenceEngine
+from deepinteract_tpu.serving.fleet import (
+    batch_slots,
+    mesh_label,
+    mesh_label_prefix,
+    mesh_placement,
+    parse_mesh_shape,
+)
+
+from tests.test_data_layer import make_raw_complex
+
+KNN, GEO = 6, 2
+
+
+def tiny_model_cfg(**overrides):
+    return ModelConfig(
+        gnn=GTConfig(num_layers=2, hidden=16, num_heads=2, shared_embed=8,
+                     dropout_rate=0.0),
+        decoder=DecoderConfig(num_chunks=1, num_channels=8,
+                              dilation_cycle=(1,)),
+        **overrides,
+    )
+
+
+def fresh_raw(seed, n1=20, n2=16):
+    return make_raw_complex(n1, n2, np.random.default_rng(seed), knn=KNN)
+
+
+def _mk_engine(mesh=None, threshold=512, seed=7, **model_overrides):
+    return InferenceEngine(
+        tiny_model_cfg(**model_overrides),
+        cfg=EngineConfig(max_batch=8, mesh_shape=mesh,
+                         pair_shard_threshold=threshold),
+        seed=seed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pure helpers: topology parsing, placement policy, slot lift, warm prefixes
+# ---------------------------------------------------------------------------
+
+
+def test_parse_mesh_shape_accepts_strings_tuples_and_empty():
+    assert parse_mesh_shape(None) == (1, 1)
+    assert parse_mesh_shape("") == (1, 1)
+    assert parse_mesh_shape("4x1") == (4, 1)
+    assert parse_mesh_shape("2X2") == (2, 2)
+    assert parse_mesh_shape((1, 4)) == (1, 4)
+    assert parse_mesh_shape([2, 2]) == (2, 2)
+    for bad in ("4", "4x0", "0x2", "axb", "1x2x3"):
+        with pytest.raises(ValueError):
+            parse_mesh_shape(bad)
+
+
+def test_mesh_labels_single_device_is_unprefixed():
+    assert mesh_label((4, 1)) == "4x1"
+    assert mesh_label_prefix((1, 1)) == ""
+    # PREFIX (not suffix): the router's warm check is startswith(), so
+    # topology must lead the label.
+    assert mesh_label_prefix((2, 2)) == "mesh2x2/"
+
+
+def test_mesh_placement_policy():
+    assert mesh_placement((1, 1), 512, 512, 512) == "single"
+    assert mesh_placement((4, 1), 512, 512, 512) == "data"  # no pair axis
+    assert mesh_placement((2, 2), 64, 64, 512) == "data"    # under threshold
+    assert mesh_placement((2, 2), 512, 256, 512) == "pair"  # max(dims) >= thr
+    assert mesh_placement((2, 2), 512, 512, 0) == "data"    # 0 disables pair
+
+
+def test_batch_slots_lift_to_data_axis():
+    assert batch_slots(1, 8) == 1
+    assert batch_slots(3, 8) == 4
+    assert batch_slots(1, 8, lift_to=4) == 4   # data placement lifts floor
+    assert batch_slots(6, 8, lift_to=4) == 8
+    assert batch_slots(1, 2, lift_to=4) == 2   # max_batch cap wins
+
+
+def test_warm_bucket_prefixes_carry_topology():
+    from deepinteract_tpu.cli.serve import warm_bucket_prefixes
+
+    assert warm_bucket_prefixes("128x128x1") == ("128x128/b1/",)
+    # Data placement lifts slots to the data axis, pair placement does not.
+    assert warm_bucket_prefixes("128x128x1", mesh_shape=(4, 1)) == (
+        "mesh4x1/128x128/b4/",)
+    assert warm_bucket_prefixes(
+        "128x128x1,512x512x1", mesh_shape=(2, 2),
+        pair_shard_threshold=512,
+    ) == ("mesh2x2/128x128/b2/", "mesh2x2/512x512/b1/")
+
+
+# ---------------------------------------------------------------------------
+# Shared engines (module-scoped: one AOT compile each)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_single():
+    eng = _mk_engine()
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def engine_data():
+    eng = _mk_engine(mesh=(4, 1))
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def engine_pair():
+    # Threshold 64 puts the test bucket (64x64) on the pair-sharded path.
+    eng = _mk_engine(mesh=(2, 2), threshold=64)
+    yield eng
+    eng.close()
+
+
+def test_placement_for_routes_by_policy(engine_single, engine_data,
+                                        engine_pair):
+    assert engine_single.placement_for(64, 64) == "single"
+    assert engine_data.placement_for(64, 64) == "data"
+    assert engine_pair.placement_for(64, 64) == "pair"
+    assert engine_pair.placement_for(32, 32) == "data"  # under threshold
+
+
+def test_data_parallel_decode_matches_single_device(engine_single,
+                                                    engine_data):
+    raw = fresh_raw(42)
+    ref = engine_single.predict(raw)
+    out = engine_data.predict(raw)
+    # f32 everywhere: the data axis only changes WHERE slots live, never
+    # the math — parity is bit-exact, not approximate.
+    assert np.array_equal(np.asarray(ref["probs"]), np.asarray(out["probs"]))
+    assert out["probs"].shape == (20, 16)
+
+
+def test_pair_sharded_decode_matches_single_device(engine_single,
+                                                   engine_pair):
+    raw = fresh_raw(43)
+    ref = engine_single.predict(raw)
+    out = engine_pair.predict(raw)
+    # Row sharding splits the decoder's instance-norm reductions across
+    # shards and XLA reorders the combine, so f32 parity is rounding
+    # noise (ULP-level), not guaranteed bitwise equality like the data
+    # axis.
+    np.testing.assert_allclose(np.asarray(out["probs"]),
+                               np.asarray(ref["probs"]),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_padded_masked_parity_asymmetric_chains(engine_single, engine_pair):
+    # Different real lengths in one bucket: padding rows must not leak
+    # across shard boundaries.
+    raw = fresh_raw(44, n1=30, n2=9)
+    ref = engine_single.predict(raw)
+    out = engine_pair.predict(raw)
+    assert out["probs"].shape == (30, 9)
+    np.testing.assert_allclose(np.asarray(out["probs"]),
+                               np.asarray(ref["probs"]),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_warm_mesh_bucket_adds_zero_retraces(engine_data):
+    raw = fresh_raw(45)
+    engine_data.predict(raw)  # compile (or reuse) the mesh entry
+    warm_traces = engine_data.trace_count
+    for seed in (46, 47):
+        engine_data.predict(fresh_raw(seed))
+    assert engine_data.trace_count == warm_traces
+
+
+def test_stats_report_topology_and_compile_inventory(engine_single,
+                                                     engine_data,
+                                                     engine_pair):
+    assert engine_single.stats()["mesh_shape"] == "1x1"
+    stats = engine_data.stats()
+    assert stats["mesh_shape"] == "4x1"
+    inventory = stats["compile_inventory"]
+    assert inventory  # predict tests above compiled at least one entry
+    for label, info in inventory.items():
+        assert label.startswith("mesh4x1/")
+        assert info["mesh_shape"] == "4x1"
+        assert info["placement"] in ("data", "repl")
+        assert info["seconds"] >= 0
+    pair_inv = engine_pair.stats()["compile_inventory"]
+    assert any(label.endswith("/pair") for label in pair_inv)
+
+
+def test_compile_cache_keys_carry_mesh_topology(engine_single, engine_data):
+    # Satellite 1 (the bugfix): a 1-chip entry and a 4-chip entry for the
+    # SAME bucket must live under different keys.
+    single_tails = {k[-2:] for k in engine_single._executables}
+    data_tails = {k[-2:] for k in engine_data._executables}
+    assert all(tail[0] == (1, 1) for tail in single_tails)
+    assert all(tail[0] == (4, 1) for tail in data_tails)
+    assert not (single_tails & data_tails)
+
+
+def test_data_engine_lifts_slots_to_data_axis(engine_data, engine_pair):
+    # normalize_warmup mirrors _flush: data placement pads the batch to
+    # the data-axis size so slots shard evenly; pair placement keeps b1.
+    assert engine_data.normalize_warmup(64, 64, 1)[2] == 4
+    assert engine_pair.normalize_warmup(64, 64, 1)[2] == 1
+
+
+def test_pair_parity_bf16_within_tolerance():
+    single = _mk_engine(compute_dtype="bfloat16")
+    pair = _mk_engine(mesh=(2, 2), threshold=64, compute_dtype="bfloat16")
+    try:
+        raw = fresh_raw(48)
+        ref = np.asarray(single.predict(raw)["probs"])
+        out = np.asarray(pair.predict(raw)["probs"])
+        # bf16 reductions tile differently across shards; parity is
+        # approximate by design under the low-precision policy.
+        np.testing.assert_allclose(out, ref, atol=2e-2)
+    finally:
+        single.close()
+        pair.close()
+
+
+def test_pair_parity_deeplab_decoder():
+    deeplab = dict(
+        interact_module_type="deeplab",
+        deeplab=DeepLabConfig(stem_channels=4, stage_channels=(4, 8, 8, 8),
+                              stage_blocks=(1, 1, 1, 1), aspp_rates=(2, 4, 6),
+                              decoder_channels=8, high_res_channels=4,
+                              dropout_rate=0.0))
+    single = _mk_engine(**deeplab)
+    pair = _mk_engine(mesh=(2, 2), threshold=64, **deeplab)
+    try:
+        raw = fresh_raw(49)
+        ref = np.asarray(single.predict(raw)["probs"])
+        out = np.asarray(pair.predict(raw)["probs"])
+        # DeepLab's ASPP image-level pooling is a cross-shard mean, so
+        # exact bitwise equality is not guaranteed under row sharding;
+        # f32 keeps the difference at rounding noise.
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+    finally:
+        single.close()
+        pair.close()
+
+
+def test_tuning_store_overrides_placement_policy(tmp_path):
+    from deepinteract_tpu import constants
+    from deepinteract_tpu.tuning.space import (
+        TrialConfig,
+        bucket_key,
+        model_signature,
+    )
+    from deepinteract_tpu.tuning.store import TuningStore, runtime_key
+
+    top = int(constants.CHAIN_LENGTH_BUCKETS[-1])
+    path = str(tmp_path / "tuning_store.json")
+    store = TuningStore(path)
+    store.put(
+        runtime_key(model_signature(tiny_model_cfg()),
+                    bucket_key(1, top, mesh_shape=(2, 2))),
+        {"config": TrialConfig(mesh_placement="data").to_dict(),
+         "objective": "serve_ms", "value": 1.0, "partial": False})
+    store.save()
+    eng = InferenceEngine(
+        tiny_model_cfg(),
+        cfg=EngineConfig(mesh_shape=(2, 2), pair_shard_threshold=1,
+                         tuning_store=path),
+        seed=7)
+    try:
+        # Threshold 1 means the policy alone says "pair" everywhere; the
+        # tuned entry pins the adoption bucket (the top bucket) to "data"
+        # while other buckets stay on policy.
+        assert eng.placement_for(top, top) == "data"
+        assert eng.placement_for(64, 64) == "pair"
+    finally:
+        eng.close()
+
+
+def test_mesh_topology_key_in_tuning_bucket():
+    from deepinteract_tpu.tuning.space import bucket_key
+
+    assert bucket_key(1, 256) == bucket_key(1, 256, mesh_shape=(1, 1))
+    assert bucket_key(1, 256, mesh_shape=(2, 2)).endswith("_m2x2")
+
+
+# ---------------------------------------------------------------------------
+# Topology-aware routing (no engines, no jax: fakes + stubs)
+# ---------------------------------------------------------------------------
+
+
+class _FakeSupervisor:
+    def __init__(self, healths):
+        self._healths = dict(healths)
+
+    def routable_workers(self):
+        return [{"worker_id": wid, "health": dict(h)}
+                for wid, h in self._healths.items()]
+
+    def worker_info(self, worker_id):
+        return {"state": "healthy", "health": dict(self._healths[worker_id])}
+
+    def stats(self):
+        return {
+            "states": {"healthy": len(self._healths)},
+            "workers": {wid: {"state": "healthy", "health": dict(h)}
+                        for wid, h in self._healths.items()},
+            "restarts_total": 0, "circuit_open": 0,
+            "circuit_tripped_total": 0, "preemptions": 0,
+            "state_path": "/dev/null",
+        }
+
+
+def _router(healths, **cfg_kwargs):
+    from deepinteract_tpu.serving.router import FleetRouter, RouterConfig
+
+    router = FleetRouter(_FakeSupervisor(healths),
+                         cfg=RouterConfig(**cfg_kwargs))
+    router._active = list(healths)
+    return router
+
+
+def _health(mesh_shape="1x1", sig="sig-a"):
+    return {"status": "ok", "weights_signature": sig,
+            "mesh_shape": mesh_shape, "warm_buckets": []}
+
+
+def test_router_prefers_pair_workers_for_huge_buckets():
+    healths = {"w0": _health("4x1"), "w1": _health("2x2"),
+               "w2": _health("4x1")}
+    router = _router(healths, pair_bucket_threshold=512)
+    # Huge-complex hint: the pair-capable worker leads every sequence;
+    # data-parallel workers remain as the failover tail.
+    seq = router._pick_sequence("512x256")
+    assert seq[0] == "w1" and set(seq) == {"w0", "w1", "w2"}
+    # Small-bucket hint: plain bucket affinity, no reorder requirement.
+    assert set(router._pick_sequence("64x64")) == {"w0", "w1", "w2"}
+
+
+def test_router_pair_preference_needs_threshold_and_hint():
+    healths = {"w0": _health("2x2")}
+    router = _router(healths, pair_bucket_threshold=0)
+    assert not router._wants_pair_worker("512x512")  # 0 disables
+    router2 = _router(healths, pair_bucket_threshold=512)
+    assert router2._wants_pair_worker("512x256")
+    assert not router2._wants_pair_worker("64x64")
+    assert not router2._wants_pair_worker(None)
+    assert not router2._wants_pair_worker("garbage")
+
+
+def test_router_warm_check_rejects_wrong_topology():
+    warm = ["mesh2x2/512x512/b1/k6g2/pair"]
+    healths = {
+        "right": dict(_health("2x2"), warm_buckets=warm),
+        "wrong": dict(_health("4x1"), warm_buckets=warm),
+    }
+    router = _router(healths, required_mesh_shape="2x2",
+                     required_warm_buckets=("mesh2x2/512x512/b1/",))
+    assert router._is_warm("right", None)
+    assert not router._is_warm("wrong", None)
+
+
+def test_router_contract_reports_mesh_shape():
+    router = _router({"w0": _health()})
+    assert router.final_contract()["mesh_shape"] == "1x1"
+    router2 = _router({"w0": _health("2x2")}, required_mesh_shape="2x2")
+    assert router2.final_contract()["mesh_shape"] == "2x2"
+
+
+def test_stub_worker_advertises_mesh_shape():
+    from deepinteract_tpu.serving.worker_stub import StubWorker
+
+    def mk(**kwargs):
+        return StubWorker("w0", "sig-a", [], delay_ms=0.0,
+                          warm_after_s=0.0, **kwargs)
+
+    assert mk(mesh_shape="2x2").healthz()["mesh_shape"] == "2x2"
+    assert mk().healthz()["mesh_shape"] == "1x1"
+
+
+def test_scheduler_flush_quantum_fires_on_full_mesh_batch():
+    """A data-axis-full group flushes immediately (it is already one
+    complete mesh dispatch) instead of waiting out max_delay_ms."""
+    import time
+
+    from deepinteract_tpu.serving.scheduler import MicroBatchScheduler
+
+    groups = []
+    def flush(key, payloads):
+        groups.append(len(payloads))
+        return list(payloads)
+
+    sched = MicroBatchScheduler(flush, max_batch=8, max_delay_ms=5000.0,
+                                flush_quantum=4)
+    try:
+        futs = [sched.submit("b", i) for i in range(4)]
+        t0 = time.monotonic()
+        for fut in futs:
+            fut.result(timeout=2.0)
+        assert time.monotonic() - t0 < 2.0  # not the 5s delay path
+        assert groups == [4]
+    finally:
+        sched.drain(timeout=5.0)
+    # quantum <= 1 keeps the legacy delay/max_batch-only triggers, and
+    # the constructor clamps it into [1, max_batch].
+    sched2 = MicroBatchScheduler(lambda key, payloads: payloads,
+                                 max_batch=2, flush_quantum=64)
+    try:
+        assert sched2.flush_quantum == 2
+    finally:
+        sched2.drain(timeout=5.0)
+
+
+def test_stub_worker_cmd_threads_mesh_shape_flag():
+    from deepinteract_tpu.serving.fleet import stub_worker_cmd
+
+    cmd = stub_worker_cmd("w0", 18080, "/tmp/hb", {"mesh_shape": "2x2"})
+    idx = cmd.index("--mesh_shape")
+    assert cmd[idx + 1] == "2x2"

@@ -163,6 +163,39 @@ def test_rollover_keys_gate_including_zero_baseline_drops():
                for r in verdict["regressions"])
 
 
+def test_mesh_serving_keys_gate_both_directions():
+    """ISSUE-20 satellite: the bench `mesh_serving` keys gate —
+    throughput_ratio higher-is-better, p512_latency_ms lower-is-better —
+    and losing either is the plumbing class."""
+    base = dict(GOOD, mesh_serving={"throughput_ratio": 2.0,
+                                    "p512_latency_ms": 400.0})
+    verdict = compare(dict(base), base)
+    assert verdict["ok"]
+    assert {"mesh_serving.throughput_ratio",
+            "mesh_serving.p512_latency_ms"} <= set(verdict["compared"])
+    # The mesh losing its throughput edge over one chip regresses.
+    slow = dict(GOOD, mesh_serving={"throughput_ratio": 1.0,
+                                    "p512_latency_ms": 400.0})
+    verdict = compare(slow, base)
+    assert {r["key"] for r in verdict["regressions"]} == {
+        "mesh_serving.throughput_ratio"}
+    # The pair-sharded p512 latency blowing past its band regresses;
+    # getting FASTER is an improvement, never a failure.
+    verdict = compare(dict(GOOD, mesh_serving={
+        "throughput_ratio": 2.0, "p512_latency_ms": 900.0}), base)
+    assert {r["key"] for r in verdict["regressions"]} == {
+        "mesh_serving.p512_latency_ms"}
+    verdict = compare(dict(GOOD, mesh_serving={
+        "throughput_ratio": 2.0, "p512_latency_ms": 100.0}), base)
+    assert verdict["ok"]
+    # Losing a mesh key entirely is the plumbing class.
+    lost = dict(GOOD, mesh_serving={"throughput_ratio": 2.0})
+    verdict = compare(lost, base)
+    assert any(r["kind"] == "plumbing"
+               and r["key"] == "mesh_serving.p512_latency_ms"
+               for r in verdict["regressions"])
+
+
 def test_recovery_keys_gate_including_cadence_ceiling():
     """ISSUE-14 satellite: the bench `recovery` keys gate. A zero
     steps_reexecuted baseline (kill landed exactly on a save) still

@@ -72,11 +72,13 @@ CONTRACTS = {
     "fleet": {
         # preemptions + versions are the ISSUE-16 additions: expected
         # capacity losses absorbed (no circuit penalty) and the count of
-        # live checkpoint versions behind the router.
+        # live checkpoint versions behind the router. mesh_shape
+        # (ISSUE-20, non-numeric "DxP") is the topology this router
+        # requires of its workers — "1x1" for a single-device fleet.
         "required": ("schema", "metric", "value", "unit", "ok",
                      "workers", "healthy", "restarts", "circuit_open",
                      "rollovers", "failovers", "routed", "preemptions",
-                     "versions"),
+                     "versions", "mesh_shape"),
         "numeric": ("value", "workers", "healthy", "restarts",
                     "circuit_open", "rollovers", "failovers", "routed",
                     "preemptions", "versions"),

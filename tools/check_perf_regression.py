@@ -117,6 +117,14 @@ TOLERANCES = {
     # itself; the absolute prefetch-on scanned rate rides along.
     "input_pipeline.prefetch_overlap_ratio": (0.25, +1),
     "input_pipeline.scan_prefetch_cps": (0.35, +1),
+    # Mesh-sharded serving contract (bench `mesh_serving` section,
+    # ISSUE-20): the data-parallel mixed-traffic throughput ratio over a
+    # single chip (higher-is-better, wide band — on the CPU rehearsal the
+    # virtual mesh shares one core, so the ratio mostly tracks
+    # coordination overhead) and the pair-sharded p512 single-complex
+    # latency (lower-is-better).
+    "mesh_serving.throughput_ratio": (0.30, +1),
+    "mesh_serving.p512_latency_ms": (0.50, -1),
     # Assembly contract (bench `assembly` section, ISSUE-19): k-chain
     # complex scoring throughput (C(k,2) pairs through the encode-once
     # + micro-batched-decode path), and the encode-once invariant
